@@ -1,0 +1,25 @@
+"""KubeTPU — a TPU-native cluster scheduling & runtime-injection framework.
+
+Reimplements the capability surface of Microsoft/KubeGPU (reference:
+Bhaskers-Blu-Org2/KubeGPU — a Go k8s extension stack for topology-aware GPU
+scheduling; see SURVEY.md for the full structural analysis) as an idiomatic
+TPU-first design:
+
+- ``topology``  — explicit ICI torus-mesh model (reference: the hierarchical
+  ``gpugrpN/...`` grouped-resource tree, SURVEY.md §3 "Core types").
+- ``tpuplugin`` — chip enumeration / advertisement backends (reference:
+  ``plugins/nvidiagpuplugin``, NVML-backed, SURVEY.md §3).
+- ``allocator`` — gang/contiguous-slice allocator (reference: ``grpalloc`` +
+  ``plugins/gpuschedulerplugin``, SURVEY.md §3).
+- ``scheduler`` — extender-shaped filter/prioritize/bind service (reference:
+  ``device-scheduler``, SURVEY.md §3).
+- ``kubemeta``  — annotation codec + fake control plane (reference:
+  ``kubeinterface`` + the k8s apiserver, SURVEY.md §3).
+- ``crishim``   — runtime-injection layer (reference: ``crishim``, which
+  injected ``NVIDIA_VISIBLE_DEVICES``; here ``TPU_VISIBLE_CHIPS`` /
+  ``TPU_WORKER_ID``, SURVEY.md §4.3).
+- ``models`` / ``parallel`` / ``ops`` / ``workloads`` — the JAX/XLA workload
+  layer exercising the full path (reference: ``example/`` pod specs).
+"""
+
+__version__ = "0.1.0"

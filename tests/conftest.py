@@ -1,0 +1,17 @@
+"""Test harness config: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Multi-chip TPU hardware is not available in CI; all sharding/pjit tests run
+against ``xla_force_host_platform_device_count=8`` virtual CPU devices (the
+same mechanism the driver's dryrun uses).  Must run before anything imports
+jax, hence top of conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

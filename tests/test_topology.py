@@ -1,0 +1,207 @@
+"""Topology layer tests: mesh construction, slice algebra, locality.
+
+Mirrors the reference's test mode (SURVEY.md §5): pure in-memory synthetic
+topologies, no hardware.
+"""
+
+import itertools
+
+import pytest
+
+from kubegpu_tpu.topology import (
+    TOPOLOGY_REGISTRY,
+    TopologySpec,
+    TpuTopology,
+    enumerate_placements,
+    find_free_placements,
+    get_topology,
+    ici_locality,
+    subslice_shapes,
+    traffic_pairs_for_mesh_axes,
+)
+from kubegpu_tpu.topology.locality import mean_hop_distance
+from kubegpu_tpu.topology.slices import (
+    fragmentation_score,
+    host_aligned,
+    partition_by_host,
+)
+
+
+class TestMeshConstruction:
+    def test_v4_8_shape(self):
+        t = get_topology("v4-8")
+        assert t.spec.num_chips == 4
+        assert t.spec.num_hosts == 1
+        assert len(t.chips) == 4
+        assert {c.coord for c in t.chips} == {
+            (0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)
+        }
+
+    def test_v5e_64_hosts(self):
+        t = get_topology("v5e-64")
+        assert t.spec.num_chips == 64
+        assert t.spec.num_hosts == 16
+        # every host owns exactly one 2x2 block
+        for h in t.hosts:
+            assert len(h.chip_indices) == 4
+            coords = [t.chips[i].coord for i in h.chip_indices]
+            xs = {c[0] for c in coords}
+            ys = {c[1] for c in coords}
+            assert len(xs) == 2 and len(ys) == 2
+            assert max(xs) - min(xs) == 1 and max(ys) - min(ys) == 1
+
+    def test_host_ids_deterministic(self):
+        a = get_topology("v5e-16")
+        b = get_topology("v5e-16")
+        assert [h.block_origin for h in a.hosts] == [
+            h.block_origin for h in b.hosts
+        ]
+
+    def test_neighbors_interior_2d(self):
+        t = get_topology("v5e-64")
+        n = set(t.neighbors((3, 3, 0)))
+        assert n == {(2, 3, 0), (4, 3, 0), (3, 2, 0), (3, 4, 0)}
+
+    def test_neighbors_corner_no_wrap(self):
+        t = get_topology("v5e-64")  # 8x8, no wrap
+        n = set(t.neighbors((0, 0, 0)))
+        assert n == {(1, 0, 0), (0, 1, 0)}
+
+    def test_neighbors_wraparound(self):
+        t = get_topology("v5e-256")  # 16x16 full pod, wrapped
+        n = set(t.neighbors((0, 0, 0)))
+        assert (15, 0, 0) in n and (0, 15, 0) in n
+
+    def test_hop_distance_wrap(self):
+        t = get_topology("v5e-256")
+        assert t.hop_distance((0, 0, 0), (15, 0, 0)) == 1
+        assert t.hop_distance((0, 0, 0), (8, 0, 0)) == 8
+
+    def test_links_count_unwrapped(self):
+        t = get_topology("v5e-16")  # 4x4 grid: 2*4*3 = 24 edges
+        assert sum(1 for _ in t.links()) == 24
+
+    def test_links_count_wrapped(self):
+        t = get_topology("v5e-256")  # 16x16 torus: 2 * 256 edges
+        assert sum(1 for _ in t.links()) == 512
+
+    def test_bad_host_block_rejected(self):
+        with pytest.raises(ValueError):
+            TopologySpec(name="bad", generation="v5e",
+                         mesh_shape=(3, 3, 1), host_block=(2, 2, 1))
+
+    def test_registry_has_baseline_topologies(self):
+        # BASELINE.json configs name v4-8, v5e-16, v5e-64
+        for name in ("v4-8", "v5e-16", "v5e-64"):
+            assert name in TOPOLOGY_REGISTRY
+
+
+class TestSliceAlgebra:
+    def test_subslice_shapes_exact(self):
+        shapes = subslice_shapes(4, (4, 4, 1))
+        assert (2, 2, 1) in shapes and (4, 1, 1) in shapes and (1, 4, 1) in shapes
+        # compact-first ordering: 2x2 beats 4x1
+        assert shapes[0] == (2, 2, 1)
+
+    def test_subslice_shapes_nonfitting(self):
+        assert subslice_shapes(32, (4, 4, 1)) == []  # 32 > 16 chips
+
+    def test_enumerate_placements_count(self):
+        t = get_topology("v5e-16")
+        # 2x2 in 4x4 grid, no wrap: 3*3 = 9 placements
+        assert len(enumerate_placements(t, (2, 2, 1))) == 9
+
+    def test_enumerate_placements_wrap(self):
+        t = get_topology("v5e-256")
+        # wrapped axis: all 16 origins legal per axis
+        ps = enumerate_placements(t, (2, 2, 1))
+        assert len(ps) == 256
+
+    def test_find_free_respects_occupancy(self):
+        t = get_topology("v5e-16")
+        occupied = {(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)}
+        free = find_free_placements(t, occupied, (2, 2, 1))
+        for p in free:
+            assert not (set(p.coords) & occupied)
+        assert len(free) == 9 - 4  # placements overlapping the 2x2 corner: 4
+
+    def test_full_mesh_placement(self):
+        t = get_topology("v4-8")
+        ps = enumerate_placements(t, (2, 2, 1))
+        assert len(ps) == 1
+        assert len(ps[0].coords) == 4
+
+    def test_host_aligned(self):
+        t = get_topology("v5e-16")
+        aligned = [p for p in enumerate_placements(t, (2, 2, 1))
+                   if host_aligned(t, p)]
+        # only the 4 host blocks themselves are aligned
+        assert len(aligned) == 4
+
+    def test_partition_by_host_ordering(self):
+        t = get_topology("v5e-16")
+        full = enumerate_placements(t, (4, 4, 1))[0]
+        parts = partition_by_host(t, full)
+        assert [hid for hid, _ in parts] == [0, 1, 2, 3]
+        assert all(len(cs) == 4 for _, cs in parts)
+
+    def test_fragmentation_prefers_corner(self):
+        t = get_topology("v5e-64")
+        corner = next(p for p in enumerate_placements(t, (2, 2, 1))
+                      if p.origin == (0, 0, 0))
+        center = next(p for p in enumerate_placements(t, (2, 2, 1))
+                      if p.origin == (3, 3, 0))
+        assert fragmentation_score(t, set(), corner) > \
+               fragmentation_score(t, set(), center)
+
+
+class TestLocality:
+    def test_dp_ring_on_line_is_fully_local(self):
+        t = get_topology("v5e-16")
+        coords = [(x, 0, 0) for x in range(4)]
+        tm = traffic_pairs_for_mesh_axes(coords, {"dp": 4})
+        # open line: wrap pair (3,0,0)-(0,0,0) is 3 hops → 3 of 4 pairs local
+        assert ici_locality(t, tm) == pytest.approx(3 / 4)
+
+    def test_dp_ring_on_torus_fully_local(self):
+        t = get_topology("v5e-256")
+        coords = [(x, 0, 0) for x in range(16)]
+        tm = traffic_pairs_for_mesh_axes(coords, {"dp": 16})
+        assert ici_locality(t, tm) == pytest.approx(1.0)
+
+    def test_2d_mesh_axes_on_2d_block(self):
+        t = get_topology("v5e-16")
+        # 2x2 logical (dp, tp) over a 2x2 physical block, row-major
+        coords = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+        tm = traffic_pairs_for_mesh_axes(coords, {"dp": 2, "tp": 2})
+        assert ici_locality(t, tm) == pytest.approx(1.0)
+
+    def test_axis_weights(self):
+        t = get_topology("v5e-16")
+        # tp axis local, dp axis non-adjacent (distance 2): weighting tp
+        # heavily must raise the score
+        coords = [(0, 0, 0), (0, 1, 0), (2, 0, 0), (2, 1, 0)]
+        tm_flat = traffic_pairs_for_mesh_axes(coords, {"dp": 2, "tp": 2})
+        tm_tp = traffic_pairs_for_mesh_axes(
+            coords, {"dp": 2, "tp": 2}, axis_weights={"tp": 10.0, "dp": 1.0})
+        assert ici_locality(t, tm_tp) > ici_locality(t, tm_flat)
+
+    def test_mesh_size_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            traffic_pairs_for_mesh_axes([(0, 0, 0)], {"dp": 2})
+
+    def test_mean_hop_distance(self):
+        t = get_topology("v5e-16")
+        coords = [(0, 0, 0), (0, 1, 0)]
+        tm = traffic_pairs_for_mesh_axes(coords, {"tp": 2})
+        assert mean_hop_distance(t, tm) == pytest.approx(1.0)
+
+    def test_compact_placement_beats_skinny_for_2d_sharding(self):
+        """The load-bearing property: topology-aware scoring must prefer a
+        4x4 block over a 16x1 line for a (4,4) logical mesh."""
+        t = get_topology("v5e-64")
+        block = [(x, y, 0) for x in range(4) for y in range(4)]
+        tm_block = traffic_pairs_for_mesh_axes(block, {"dp": 4, "tp": 4})
+        line = [(x, 0, 0) for x in range(8)] + [(x, 1, 0) for x in range(8)]
+        tm_line = traffic_pairs_for_mesh_axes(line, {"dp": 4, "tp": 4})
+        assert ici_locality(t, tm_block) > ici_locality(t, tm_line)
