@@ -1,0 +1,61 @@
+"""Metadata transport — reference: ``kubeinterface`` + the k8s apiserver.
+
+The reference's key architectural property (SURVEY.md §2): scheduler and
+node agent NEVER talk directly — all coordination rides on Node/Pod
+annotations through the apiserver, making every component independently
+restartable and testable against a fake apiserver.  KubeTPU preserves this:
+``objects`` are k8s-shaped dataclasses, ``codec`` converts advertisement /
+request / allocation structs ⇄ annotation JSON, and ``controlplane`` is the
+in-process fake apiserver (create/get/list/patch/delete/watch) the whole
+test suite runs against (SURVEY.md §5 "simulated control plane").
+"""
+
+from kubegpu_tpu.kubemeta.objects import (
+    ContainerSpec,
+    GangSpec,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    ResourceRequests,
+)
+from kubegpu_tpu.kubemeta.codec import (
+    ALLOCATE_FROM_KEY,
+    DEVICE_INFO_KEY,
+    GANG_KEY,
+    MESH_AXES_KEY,
+    AllocatedChip,
+    Allocation,
+    advertise_on_node,
+    allocation_from_annotation,
+    allocation_to_annotation,
+    node_advertisement,
+    node_advertisement_from_annotation,
+    node_advertisement_to_annotation,
+    pod_allocation,
+    pod_gang_spec,
+    pod_mesh_axes,
+    set_pod_allocation,
+    set_pod_gang,
+    set_pod_mesh_axes,
+)
+from kubegpu_tpu.kubemeta.controlplane import (
+    Conflict,
+    FakeApiServer,
+    NotFound,
+    WatchEvent,
+)
+
+__all__ = [
+    "ContainerSpec", "GangSpec", "Node", "ObjectMeta", "Pod", "PodPhase",
+    "PodSpec", "ResourceRequests",
+    "ALLOCATE_FROM_KEY", "DEVICE_INFO_KEY", "GANG_KEY", "MESH_AXES_KEY",
+    "AllocatedChip", "Allocation", "advertise_on_node",
+    "allocation_from_annotation", "allocation_to_annotation",
+    "node_advertisement", "node_advertisement_from_annotation",
+    "node_advertisement_to_annotation", "pod_allocation", "pod_gang_spec",
+    "pod_mesh_axes", "set_pod_allocation", "set_pod_gang",
+    "set_pod_mesh_axes",
+    "Conflict", "FakeApiServer", "NotFound", "WatchEvent",
+]

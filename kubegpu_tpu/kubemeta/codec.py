@@ -1,0 +1,193 @@
+"""Annotation codec — reference: ``kubeinterface/kubeinterface.go``.
+
+Bidirectional conversion between internal structs and annotation JSON
+(SURVEY.md §3: ``NodeInfoToAnnotation`` / ``AnnotationToNodeInfo`` /
+``PodInfoToAnnotation``).  Annotation keys mirror the reference's
+``node.alpha/DeviceInformation`` / ``pod.alpha/DeviceInformation`` naming.
+
+Annotations — not in-memory state — are the source of truth: the scheduler
+rebuilds its cache from them after restart (SURVEY.md §4.4 correctness
+subtlety), so every field the scheduler needs must round-trip losslessly.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from kubegpu_tpu.kubemeta.objects import GangSpec, Node, Pod
+from kubegpu_tpu.topology.mesh import Coord
+from kubegpu_tpu.tpuplugin.backend import ChipAdvertisement, NodeAdvertisement
+
+DEVICE_INFO_KEY = "node.alpha.kubetpu/device-information"
+ALLOCATE_FROM_KEY = "pod.alpha.kubetpu/allocate-from"
+GANG_KEY = "pod.alpha.kubetpu/gang"
+MESH_AXES_KEY = "pod.alpha.kubetpu/mesh-axes"
+
+
+# ---------------------------------------------------------------------------
+# Node advertisement ⇄ annotation
+# ---------------------------------------------------------------------------
+
+def node_advertisement_to_annotation(adv: NodeAdvertisement) -> str:
+    return json.dumps({
+        "nodeName": adv.node_name,
+        "sliceId": adv.slice_id,
+        "sliceType": adv.slice_type,
+        "hostId": adv.host_id,
+        "meshShape": list(adv.mesh_shape),
+        "wrap": list(adv.wrap),
+        "hostBlock": list(adv.host_block),
+        "internalIp": adv.internal_ip,
+        "chips": [
+            {
+                "coord": list(c.coord),
+                "localIndex": c.local_index,
+                "millichips": c.millichips,
+                "hbmGib": c.hbm_gib,
+                "healthy": c.healthy,
+            }
+            for c in adv.chips
+        ],
+    }, sort_keys=True)
+
+
+def node_advertisement_from_annotation(payload: str) -> NodeAdvertisement:
+    d = json.loads(payload)
+    return NodeAdvertisement(
+        node_name=d["nodeName"],
+        slice_id=d["sliceId"],
+        slice_type=d["sliceType"],
+        host_id=d["hostId"],
+        mesh_shape=tuple(d["meshShape"]),
+        wrap=tuple(bool(w) for w in d["wrap"]),
+        host_block=tuple(d["hostBlock"]),
+        internal_ip=d.get("internalIp", "127.0.0.1"),
+        chips=tuple(
+            ChipAdvertisement(
+                coord=tuple(c["coord"]),
+                local_index=c["localIndex"],
+                millichips=c["millichips"],
+                hbm_gib=c["hbmGib"],
+                healthy=c.get("healthy", True),
+            )
+            for c in d["chips"]
+        ),
+    )
+
+
+def advertise_on_node(node: Node, adv: NodeAdvertisement) -> None:
+    node.metadata.annotations[DEVICE_INFO_KEY] = \
+        node_advertisement_to_annotation(adv)
+
+
+def node_advertisement(node: Node) -> NodeAdvertisement | None:
+    payload = node.metadata.annotations.get(DEVICE_INFO_KEY)
+    return node_advertisement_from_annotation(payload) if payload else None
+
+
+# ---------------------------------------------------------------------------
+# Allocation (AllocateFrom) ⇄ pod annotation
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class AllocatedChip:
+    coord: Coord
+    local_index: int
+    millichips: int  # how much of the chip this pod holds
+
+
+@dataclass
+class Allocation:
+    """The scheduler's concrete decision for one pod — reference:
+    ``ContainerInfo.AllocateFrom`` (requested resource → device path),
+    written back as a pod annotation at bind time (SURVEY.md §4.2) and read
+    by the crishim at container-create time (SURVEY.md §4.3).
+    """
+
+    node_name: str
+    slice_id: str
+    chips: list[AllocatedChip] = field(default_factory=list)
+    worker_id: int = 0
+    num_workers: int = 1
+    coordinator_address: str = ""
+    worker_hostnames: list[str] = field(default_factory=list)
+    gang_name: str = ""
+
+
+def allocation_to_annotation(alloc: Allocation) -> str:
+    return json.dumps({
+        "nodeName": alloc.node_name,
+        "sliceId": alloc.slice_id,
+        "chips": [
+            {"coord": list(c.coord), "localIndex": c.local_index,
+             "millichips": c.millichips}
+            for c in alloc.chips
+        ],
+        "workerId": alloc.worker_id,
+        "numWorkers": alloc.num_workers,
+        "coordinatorAddress": alloc.coordinator_address,
+        "workerHostnames": alloc.worker_hostnames,
+        "gangName": alloc.gang_name,
+    }, sort_keys=True)
+
+
+def allocation_from_annotation(payload: str) -> Allocation:
+    d = json.loads(payload)
+    return Allocation(
+        node_name=d["nodeName"],
+        slice_id=d["sliceId"],
+        chips=[
+            AllocatedChip(coord=tuple(c["coord"]),
+                          local_index=c["localIndex"],
+                          millichips=c["millichips"])
+            for c in d["chips"]
+        ],
+        worker_id=d["workerId"],
+        num_workers=d["numWorkers"],
+        coordinator_address=d.get("coordinatorAddress", ""),
+        worker_hostnames=list(d.get("workerHostnames", [])),
+        gang_name=d.get("gangName", ""),
+    )
+
+
+def set_pod_allocation(pod: Pod, alloc: Allocation) -> None:
+    pod.metadata.annotations[ALLOCATE_FROM_KEY] = \
+        allocation_to_annotation(alloc)
+
+
+def pod_allocation(pod: Pod) -> Allocation | None:
+    payload = pod.metadata.annotations.get(ALLOCATE_FROM_KEY)
+    return allocation_from_annotation(payload) if payload else None
+
+
+# ---------------------------------------------------------------------------
+# Gang + mesh-axes pod annotations
+# ---------------------------------------------------------------------------
+
+def set_pod_gang(pod: Pod, gang: GangSpec) -> None:
+    pod.metadata.annotations[GANG_KEY] = json.dumps(
+        {"name": gang.name, "size": gang.size, "index": gang.index})
+
+
+def pod_gang_spec(pod: Pod) -> GangSpec | None:
+    payload = pod.metadata.annotations.get(GANG_KEY)
+    if not payload:
+        return None
+    d = json.loads(payload)
+    return GangSpec(name=d["name"], size=d["size"], index=d["index"])
+
+
+def set_pod_mesh_axes(pod: Pod, axes: dict[str, int]) -> None:
+    """Declares the workload's logical parallelism axes (ordered), e.g.
+    ``{"dp": 4, "tp": 4}`` — the scheduler's topology-scoring derives the
+    traffic model from this (SURVEY.md §8 "Honest locality measurement").
+    """
+    pod.metadata.annotations[MESH_AXES_KEY] = json.dumps(list(axes.items()))
+
+
+def pod_mesh_axes(pod: Pod) -> dict[str, int] | None:
+    payload = pod.metadata.annotations.get(MESH_AXES_KEY)
+    if not payload:
+        return None
+    return dict((k, int(v)) for k, v in json.loads(payload))

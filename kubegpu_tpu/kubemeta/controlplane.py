@@ -1,0 +1,193 @@
+"""Fake apiserver — the in-process control plane all components talk through.
+
+Reference parity (SURVEY.md §2 key property + §5): scheduler ↔ node agent
+coordination flows exclusively through apiserver objects; tests run the real
+scheduler/crishim code against this fake with identical semantics: objects
+with resourceVersion bumps, strategic-merge-style annotation patches, list
+with label selectors, and watch (delivered synchronously to subscribers —
+the informer pattern without goroutines).
+
+Thread-safe: the scheduler loop, advertiser ticks, and workload runtimes may
+touch it from different threads (SURVEY.md §6 race-detection requirement —
+stress-tested in tests/test_controlplane.py).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterator
+
+from kubegpu_tpu.kubemeta.objects import Node, Pod
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    kind: str      # "Pod" | "Node"
+    type: str      # "ADDED" | "MODIFIED" | "DELETED"
+    obj: object    # deep copy — consumers cannot mutate server state
+
+
+class Conflict(Exception):
+    """resourceVersion mismatch on update — caller must re-read and retry."""
+
+
+class NotFound(Exception):
+    pass
+
+
+@dataclass
+class _Store:
+    objects: dict[str, object] = field(default_factory=dict)
+
+
+class FakeApiServer:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._stores: dict[str, _Store] = {"Pod": _Store(), "Node": _Store()}
+        self._watchers: list[Callable[[WatchEvent], None]] = []
+        self._rv = 0
+
+    # -- internals -------------------------------------------------------
+
+    def _bump(self, obj) -> None:
+        self._rv += 1
+        obj.metadata.resource_version = self._rv
+
+    def _notify(self, ev: WatchEvent) -> None:
+        for w in list(self._watchers):
+            w(ev)
+
+    @staticmethod
+    def _key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    # -- CRUD ------------------------------------------------------------
+
+    def create(self, kind: str, obj) -> object:
+        with self._lock:
+            store = self._stores[kind]
+            key = self._key(obj.metadata.namespace, obj.metadata.name)
+            if key in store.objects:
+                raise Conflict(f"{kind} {key} already exists")
+            self._bump(obj)
+            store.objects[key] = copy.deepcopy(obj)
+            self._notify(WatchEvent(kind, "ADDED", copy.deepcopy(obj)))
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        with self._lock:
+            store = self._stores[kind]
+            key = self._key(namespace, name)
+            if key not in store.objects:
+                raise NotFound(f"{kind} {key}")
+            return copy.deepcopy(store.objects[key])
+
+    def list(self, kind: str, label_selector: dict[str, str] | None = None):
+        with self._lock:
+            out = []
+            for obj in self._stores[kind].objects.values():
+                if label_selector and any(
+                    obj.metadata.labels.get(k) != v
+                    for k, v in label_selector.items()
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, kind: str, obj) -> object:
+        """Optimistic-concurrency replace: resourceVersion must match."""
+        with self._lock:
+            store = self._stores[kind]
+            key = self._key(obj.metadata.namespace, obj.metadata.name)
+            if key not in store.objects:
+                raise NotFound(f"{kind} {key}")
+            current = store.objects[key]
+            if obj.metadata.resource_version != current.metadata.resource_version:
+                raise Conflict(
+                    f"{kind} {key}: rv {obj.metadata.resource_version} != "
+                    f"{current.metadata.resource_version}")
+            self._bump(obj)
+            store.objects[key] = copy.deepcopy(obj)
+            self._notify(WatchEvent(kind, "MODIFIED", copy.deepcopy(obj)))
+            return copy.deepcopy(obj)
+
+    def patch_annotations(self, kind: str, name: str,
+                          annotations: dict[str, str],
+                          namespace: str = "default"):
+        """Strategic-merge patch of annotations only — the reference's
+        ``client-go Patch`` path used by the advertiser and the allocation
+        write-back (SURVEY.md §4.1/§4.2).  Never conflicts.
+        """
+        with self._lock:
+            store = self._stores[kind]
+            key = self._key(namespace, name)
+            if key not in store.objects:
+                raise NotFound(f"{kind} {key}")
+            obj = store.objects[key]
+            obj.metadata.annotations.update(annotations)
+            self._bump(obj)
+            self._notify(WatchEvent(kind, "MODIFIED", copy.deepcopy(obj)))
+            return copy.deepcopy(obj)
+
+    def bind_pod(self, name: str, node_name: str,
+                 namespace: str = "default") -> None:
+        """The scheduler's bind verb (kube-scheduler posts a Binding)."""
+        from kubegpu_tpu.kubemeta.objects import PodPhase
+        with self._lock:
+            key = self._key(namespace, name)
+            pod = self._stores["Pod"].objects.get(key)
+            if pod is None:
+                raise NotFound(f"Pod {key}")
+            pod.spec.node_name = node_name
+            pod.status.phase = PodPhase.SCHEDULED
+            self._bump(pod)
+            self._notify(WatchEvent("Pod", "MODIFIED", copy.deepcopy(pod)))
+
+    def set_pod_phase(self, name: str, phase, message: str = "",
+                      exit_code: int | None = None,
+                      namespace: str = "default") -> None:
+        with self._lock:
+            key = self._key(namespace, name)
+            pod = self._stores["Pod"].objects.get(key)
+            if pod is None:
+                raise NotFound(f"Pod {key}")
+            pod.status.phase = phase
+            pod.status.message = message
+            if exit_code is not None:
+                pod.status.exit_code = exit_code
+            self._bump(pod)
+            self._notify(WatchEvent("Pod", "MODIFIED", copy.deepcopy(pod)))
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            store = self._stores[kind]
+            key = self._key(namespace, name)
+            if key not in store.objects:
+                raise NotFound(f"{kind} {key}")
+            obj = store.objects.pop(key)
+            self._notify(WatchEvent(kind, "DELETED", copy.deepcopy(obj)))
+
+    # -- watch -----------------------------------------------------------
+
+    def watch(self, callback: Callable[[WatchEvent], None]) -> Callable[[], None]:
+        """Subscribe; returns an unsubscribe function.  Events fire inside
+        the mutating call (synchronous informer) — callbacks must not
+        re-enter the apiserver with blocking writes from another thread.
+        """
+        with self._lock:
+            self._watchers.append(callback)
+        def unsubscribe() -> None:
+            with self._lock:
+                if callback in self._watchers:
+                    self._watchers.remove(callback)
+        return unsubscribe
+
+    # -- convenience -----------------------------------------------------
+
+    def pods(self) -> Iterator[Pod]:
+        yield from self.list("Pod")
+
+    def nodes(self) -> Iterator[Node]:
+        yield from self.list("Node")

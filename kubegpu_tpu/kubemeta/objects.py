@@ -1,0 +1,139 @@
+"""K8s-shaped object model — reference: ``types/types.go`` (SURVEY.md §3).
+
+The reference's ``NodeInfo{Capacity, Allocatable, Used}`` /
+``PodInfo{DevRequests, AllocateFrom}`` become: Node objects carrying the
+topology advertisement annotation, Pod objects carrying device requests
+(``kubetpu.io/tpu-chips`` whole chips, ``kubetpu.io/millitpu`` fractional —
+the reference's hierarchical ``alpha.gpu/...`` names flatten to these two
+because the mesh is explicit, not path-encoded).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+from dataclasses import dataclass, field
+
+_uid_counter = itertools.count(1)
+
+# Resource names (user surface, pod spec `resources`):
+RES_TPU_CHIPS = "kubetpu.io/tpu-chips"     # whole chips per container
+RES_MILLITPU = "kubetpu.io/millitpu"       # fractional chip, 1000 = 1 chip
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    SCHEDULED = "Scheduled"   # bound to a node, not yet started
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class ObjectMeta:
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    uid: str = field(default_factory=lambda: f"uid-{next(_uid_counter)}")
+    resource_version: int = 0
+
+
+@dataclass
+class ResourceRequests:
+    """Per-container device ask — reference: ``ContainerInfo.DevRequests``."""
+
+    tpu_chips: int = 0
+    millitpu: int = 0  # fractional ask; mutually exclusive with tpu_chips
+
+    def __post_init__(self) -> None:
+        if self.tpu_chips and self.millitpu:
+            raise ValueError("request either whole tpu-chips or millitpu, not both")
+        if self.tpu_chips < 0 or self.millitpu < 0:
+            raise ValueError("negative device request")
+
+    def to_dict(self) -> dict[str, int]:
+        out = {}
+        if self.tpu_chips:
+            out[RES_TPU_CHIPS] = self.tpu_chips
+        if self.millitpu:
+            out[RES_MILLITPU] = self.millitpu
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict[str, int]) -> "ResourceRequests":
+        return cls(tpu_chips=int(d.get(RES_TPU_CHIPS, 0)),
+                   millitpu=int(d.get(RES_MILLITPU, 0)))
+
+
+@dataclass
+class ContainerSpec:
+    name: str
+    command: list[str] = field(default_factory=list)
+    image: str = "kubetpu/runtime:latest"
+    env: dict[str, str] = field(default_factory=dict)
+    resources: ResourceRequests = field(default_factory=ResourceRequests)
+
+
+@dataclass
+class GangSpec:
+    """Gang (co-scheduling) membership — the BASELINE extension of the
+    reference's per-pod group allocation to multi-pod jobs (SURVEY.md §1
+    item 3): all ``size`` pods of ``name`` place atomically or not at all.
+    """
+
+    name: str
+    size: int
+    index: int  # this pod's rank in the gang (drives TPU_WORKER_ID)
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.index < self.size:
+            raise ValueError(f"gang index {self.index} not in [0,{self.size})")
+
+
+@dataclass
+class PodSpec:
+    containers: list[ContainerSpec] = field(default_factory=list)
+    node_name: str | None = None   # set at bind time
+    scheduler_name: str = "kubetpu-scheduler"
+
+    @property
+    def total_chips(self) -> int:
+        return sum(c.resources.tpu_chips for c in self.containers)
+
+    @property
+    def total_millitpu(self) -> int:
+        return sum(c.resources.millitpu for c in self.containers)
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    message: str = ""
+    exit_code: int | None = None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta
+    spec: PodSpec
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class NodeStatus:
+    ready: bool = True
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

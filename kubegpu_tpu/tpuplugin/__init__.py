@@ -1,0 +1,30 @@
+"""Device advertiser backends — reference: ``plugins/nvidiagpuplugin``.
+
+The reference's node-side plugin (SURVEY.md §3) used NVML to enumerate GPUs
+and their NVLink matrix, and answered ``Allocate()`` with the env/devices/
+mounts for a chosen device set.  KubeTPU's equivalent enumerates the host's
+TPU chips and their ICI mesh coordinates, and answers allocation with the
+libtpu/JAX environment (``TPU_VISIBLE_CHIPS``, ``TPU_WORKER_ID``,
+coordinator address — SURVEY.md §4.3 TPU translation).
+
+Backend selection mirrors the reference's ``.so``-plugin seam (SURVEY.md §2):
+``mock`` for tests/simulation, ``libtpu`` on real hardware (reads coords from
+the JAX TPU client).
+"""
+
+from kubegpu_tpu.tpuplugin.backend import (
+    ChipAdvertisement,
+    DeviceBackend,
+    NodeAdvertisement,
+)
+from kubegpu_tpu.tpuplugin.mock import MockBackend, mock_cluster
+from kubegpu_tpu.tpuplugin.libtpu import LibtpuBackend
+
+__all__ = [
+    "ChipAdvertisement",
+    "DeviceBackend",
+    "NodeAdvertisement",
+    "MockBackend",
+    "mock_cluster",
+    "LibtpuBackend",
+]

@@ -1,0 +1,100 @@
+"""Mock backend: deterministic coordinate tables for tests/simulation.
+
+First-class citizen by design (SURVEY.md §5: the reference's NVML paths had
+no automated coverage because they needed real GPUs — a gap this closes).
+Ships the v4-8 / v5e-16 / v5e-64 tables BASELINE.json's configs need.
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.topology.mesh import TOPOLOGY_REGISTRY, TpuTopology
+from kubegpu_tpu.tpuplugin.backend import (
+    MILLICHIPS_PER_CHIP,
+    ChipAdvertisement,
+    DeviceBackend,
+    NodeAdvertisement,
+)
+
+
+class MockBackend(DeviceBackend):
+    """Pretends to be host ``host_id`` of a ``slice_type`` slice."""
+
+    def __init__(self, slice_type: str, host_id: int = 0,
+                 slice_id: str | None = None, node_name: str | None = None,
+                 unhealthy_chips: set[int] | None = None):
+        if slice_type not in TOPOLOGY_REGISTRY:
+            raise KeyError(f"unknown slice type {slice_type!r}")
+        self.spec = TOPOLOGY_REGISTRY[slice_type]
+        if not 0 <= host_id < self.spec.num_hosts:
+            raise ValueError(
+                f"host_id {host_id} out of range for {slice_type} "
+                f"({self.spec.num_hosts} hosts)")
+        self.slice_type = slice_type
+        self.host_id = host_id
+        self.slice_id = slice_id or f"{slice_type}-slice-0"
+        self.node_name = node_name or f"{self.slice_id}-host-{host_id}"
+        self.unhealthy_chips = unhealthy_chips or set()
+
+    def discover(self) -> NodeAdvertisement:
+        topo = TpuTopology.build(self.spec)
+        host = topo.hosts[self.host_id]
+        chips = tuple(
+            ChipAdvertisement(
+                coord=topo.chips[idx].coord,
+                local_index=li,
+                millichips=MILLICHIPS_PER_CHIP,
+                hbm_gib=self.spec.hbm_gib_per_chip,
+                healthy=li not in self.unhealthy_chips,
+            )
+            for li, idx in enumerate(host.chip_indices)
+        )
+        return NodeAdvertisement(
+            node_name=self.node_name,
+            slice_id=self.slice_id,
+            slice_type=self.slice_type,
+            host_id=self.host_id,
+            mesh_shape=self.spec.mesh_shape,
+            wrap=self.spec.wrap,
+            host_block=self.spec.host_block,
+            chips=chips,
+        )
+
+    def allocate_env(self, chips, worker_id, num_workers,
+                     coordinator_address, worker_hostnames):
+        return build_tpu_env(self.spec.host_block, chips, worker_id,
+                             num_workers, coordinator_address,
+                             worker_hostnames)
+
+
+def build_tpu_env(host_block, chips, worker_id, num_workers,
+                  coordinator_address, worker_hostnames) -> dict[str, str]:
+    """The injection payload — reference parity: the crishim's env rewrite
+    set ``NVIDIA_VISIBLE_DEVICES=<uuids>`` (SURVEY.md §4.3); the TPU
+    translation sets chip visibility + worker identity + the coordinator
+    bootstrap ``jax.distributed.initialize`` consumes.
+    """
+    hb = host_block
+    return {
+        "TPU_VISIBLE_CHIPS": ",".join(str(c.local_index) for c in chips),
+        "TPU_WORKER_ID": str(worker_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(worker_hostnames),
+        "TPU_CHIPS_PER_HOST_BOUNDS": f"{hb[0]},{hb[1]},{hb[2]}",
+        "JAX_COORDINATOR_ADDRESS": coordinator_address,
+        "JAX_NUM_PROCESSES": str(num_workers),
+        "JAX_PROCESS_ID": str(worker_id),
+    }
+
+
+def mock_cluster(slice_types: list[str]) -> list[MockBackend]:
+    """One backend per host for a cluster of slices.
+
+    ``mock_cluster(["v5e-16", "v4-8"])`` → 4 + 1 = 5 node backends, each
+    slice getting a distinct ``slice_id``.
+    """
+    backends: list[MockBackend] = []
+    for i, st in enumerate(slice_types):
+        spec = TOPOLOGY_REGISTRY[st]
+        slice_id = f"{st}-slice-{i}"
+        for hid in range(spec.num_hosts):
+            backends.append(MockBackend(st, host_id=hid, slice_id=slice_id))
+    return backends

@@ -1,0 +1,218 @@
+"""Annotation round-trip + fake apiserver tests (SURVEY.md §5: the
+reference's ``kubeinterface`` tests were NodeInfo → annotation → NodeInfo
+equality; same shape here, plus control-plane semantics)."""
+
+import threading
+
+import pytest
+
+from kubegpu_tpu.kubemeta import (
+    Allocation,
+    AllocatedChip,
+    Conflict,
+    ContainerSpec,
+    FakeApiServer,
+    GangSpec,
+    Node,
+    NotFound,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    ResourceRequests,
+    advertise_on_node,
+    allocation_from_annotation,
+    allocation_to_annotation,
+    node_advertisement,
+    node_advertisement_from_annotation,
+    node_advertisement_to_annotation,
+    pod_allocation,
+    pod_gang_spec,
+    pod_mesh_axes,
+    set_pod_allocation,
+    set_pod_gang,
+    set_pod_mesh_axes,
+)
+from kubegpu_tpu.tpuplugin import MockBackend, mock_cluster
+
+
+def make_pod(name="p0", chips=1, millitpu=0) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(containers=[ContainerSpec(
+            name="main",
+            resources=ResourceRequests(tpu_chips=chips, millitpu=millitpu))]),
+    )
+
+
+class TestMockBackend:
+    def test_discover_v4_8(self):
+        adv = MockBackend("v4-8").discover()
+        assert adv.num_chips == 4
+        assert adv.mesh_shape == (2, 2, 1)
+        assert {c.coord for c in adv.chips} == {
+            (0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)}
+
+    def test_discover_v5e16_host2(self):
+        adv = MockBackend("v5e-16", host_id=2).discover()
+        assert adv.host_id == 2
+        assert adv.num_chips == 4
+        # host 2's block origin in row-major host order: (2,0)
+        assert {c.coord for c in adv.chips} == {
+            (2, 0, 0), (2, 1, 0), (3, 0, 0), (3, 1, 0)}
+
+    def test_mock_cluster_node_count(self):
+        backends = mock_cluster(["v5e-16", "v4-8"])
+        assert len(backends) == 5  # 4 hosts + 1 host
+        assert len({b.slice_id for b in backends}) == 2
+
+    def test_bad_host_id(self):
+        with pytest.raises(ValueError):
+            MockBackend("v4-8", host_id=1)
+
+    def test_allocate_env(self):
+        b = MockBackend("v5e-16", host_id=1)
+        adv = b.discover()
+        env = b.allocate_env(list(adv.chips), worker_id=1, num_workers=4,
+                             coordinator_address="10.0.0.1:8476",
+                             worker_hostnames=["h0", "h1", "h2", "h3"])
+        assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+        assert env["JAX_NUM_PROCESSES"] == "4"
+
+    def test_unhealthy_chip_marked(self):
+        adv = MockBackend("v4-8", unhealthy_chips={2}).discover()
+        assert [c.healthy for c in adv.chips] == [True, True, False, True]
+
+
+class TestCodecRoundTrips:
+    def test_node_advertisement_roundtrip(self):
+        adv = MockBackend("v5e-64", host_id=7).discover()
+        payload = node_advertisement_to_annotation(adv)
+        back = node_advertisement_from_annotation(payload)
+        assert back == adv
+
+    def test_allocation_roundtrip(self):
+        alloc = Allocation(
+            node_name="n0", slice_id="v5e-16-slice-0",
+            chips=[AllocatedChip(coord=(1, 2, 0), local_index=3,
+                                 millichips=1000)],
+            worker_id=2, num_workers=4,
+            coordinator_address="10.0.0.1:8476",
+            worker_hostnames=["h0", "h1", "h2", "h3"],
+            gang_name="job-a")
+        back = allocation_from_annotation(allocation_to_annotation(alloc))
+        assert back == alloc
+
+    def test_pod_annotation_helpers(self):
+        pod = make_pod()
+        assert pod_allocation(pod) is None
+        alloc = Allocation(node_name="n0", slice_id="s0",
+                           chips=[AllocatedChip((0, 0, 0), 0, 500)])
+        set_pod_allocation(pod, alloc)
+        assert pod_allocation(pod) == alloc
+
+    def test_gang_roundtrip(self):
+        pod = make_pod()
+        assert pod_gang_spec(pod) is None
+        set_pod_gang(pod, GangSpec(name="job-a", size=4, index=3))
+        g = pod_gang_spec(pod)
+        assert (g.name, g.size, g.index) == ("job-a", 4, 3)
+
+    def test_mesh_axes_roundtrip_preserves_order(self):
+        pod = make_pod()
+        set_pod_mesh_axes(pod, {"dp": 2, "tp": 8})
+        assert list(pod_mesh_axes(pod).items()) == [("dp", 2), ("tp", 8)]
+
+    def test_node_annotation_attach(self):
+        node = Node(metadata=ObjectMeta(name="n0"))
+        assert node_advertisement(node) is None
+        adv = MockBackend("v4-8").discover()
+        advertise_on_node(node, adv)
+        assert node_advertisement(node) == adv
+
+
+class TestFakeApiServer:
+    def test_create_get_list(self):
+        api = FakeApiServer()
+        api.create("Pod", make_pod("a"))
+        api.create("Pod", make_pod("b"))
+        assert api.get("Pod", "a").name == "a"
+        assert {p.name for p in api.list("Pod")} == {"a", "b"}
+
+    def test_create_duplicate_conflicts(self):
+        api = FakeApiServer()
+        api.create("Pod", make_pod("a"))
+        with pytest.raises(Conflict):
+            api.create("Pod", make_pod("a"))
+
+    def test_get_missing(self):
+        api = FakeApiServer()
+        with pytest.raises(NotFound):
+            api.get("Pod", "nope")
+
+    def test_mutating_copy_does_not_leak(self):
+        api = FakeApiServer()
+        api.create("Pod", make_pod("a"))
+        got = api.get("Pod", "a")
+        got.metadata.annotations["x"] = "y"
+        assert "x" not in api.get("Pod", "a").metadata.annotations
+
+    def test_optimistic_concurrency(self):
+        api = FakeApiServer()
+        created = api.create("Pod", make_pod("a"))
+        stale = api.get("Pod", "a")
+        api.update("Pod", created)  # bumps rv
+        with pytest.raises(Conflict):
+            api.update("Pod", stale)
+
+    def test_patch_annotations(self):
+        api = FakeApiServer()
+        api.create("Node", Node(metadata=ObjectMeta(name="n0")))
+        api.patch_annotations("Node", "n0", {"k": "v"})
+        assert api.get("Node", "n0").metadata.annotations["k"] == "v"
+
+    def test_bind_and_phase(self):
+        api = FakeApiServer()
+        api.create("Pod", make_pod("a"))
+        api.bind_pod("a", "n0")
+        pod = api.get("Pod", "a")
+        assert pod.spec.node_name == "n0"
+        assert pod.status.phase == PodPhase.SCHEDULED
+
+    def test_watch_events(self):
+        api = FakeApiServer()
+        events = []
+        unsub = api.watch(events.append)
+        api.create("Pod", make_pod("a"))
+        api.bind_pod("a", "n0")
+        api.delete("Pod", "a")
+        assert [e.type for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+        unsub()
+        api.create("Pod", make_pod("b"))
+        assert len(events) == 3
+
+    def test_thread_stress(self):
+        """SURVEY.md §6 race-detection requirement: concurrent patchers must
+        not lose updates or corrupt state."""
+        api = FakeApiServer()
+        api.create("Node", Node(metadata=ObjectMeta(name="n0")))
+        n_threads, n_iters = 8, 50
+        def worker(tid):
+            for i in range(n_iters):
+                api.patch_annotations("Node", "n0", {f"t{tid}-{i}": "1"})
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ann = api.get("Node", "n0").metadata.annotations
+        assert len(ann) == n_threads * n_iters
+
+    def test_resource_requests_validation(self):
+        with pytest.raises(ValueError):
+            ResourceRequests(tpu_chips=1, millitpu=500)
+        with pytest.raises(ValueError):
+            GangSpec(name="g", size=2, index=2)
