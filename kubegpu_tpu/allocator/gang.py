@@ -1,0 +1,580 @@
+"""The gang allocator core — reference: ``grpalloc.PodFitsGroupConstraints``
++ ``ComputePodScore`` (SURVEY.md §3, §4.2 hot loop).
+
+Semantics (reference parity, TPU-translated):
+- *Fit*: can this gang's total chip ask be satisfied by a free contiguous
+  sub-torus of some slice, partitioned into per-pod chunks that never span
+  a host?  (Reference: grouped requests must land in one locality group.)
+- *Score*: 0–10, combining honest ICI locality of the best logical order,
+  packing tightness, and slice fill (bin-packing pressure, BASELINE
+  config 5).  (Reference: prefer fewest groups spanned.)
+- *Atomicity*: the assignment covers every pod of the gang or ``None`` —
+  the all-or-nothing group allocation BASELINE extends to multi-pod gangs.
+
+Fractional requests (millitpu < 1000) bin-pack onto partially-used chips
+(best-fit-decreasing) and never block whole-chip slices unnecessarily.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubegpu_tpu.kubemeta.codec import AllocatedChip, Allocation
+from kubegpu_tpu.topology.mesh import Coord, TopologySpec, TpuTopology
+from kubegpu_tpu.topology.slices import (
+    Placement,
+    find_free_placements,
+    fragmentation_score,
+    subslice_shapes,
+)
+from kubegpu_tpu.tpuplugin.backend import MILLICHIPS_PER_CHIP, NodeAdvertisement
+from kubegpu_tpu.allocator.ordering import candidate_orders, evaluate_order
+
+COORDINATOR_PORT = 8476
+
+
+@dataclass
+class GangRequest:
+    """One gang's ask: N pods × (whole chips | millitpu fraction) each."""
+
+    gang_name: str
+    num_pods: int = 1
+    chips_per_pod: int = 0
+    millitpu_per_pod: int = 0
+    mesh_axes: dict[str, int] | None = None       # logical axes, ordered
+    axis_weights: dict[str, float] | None = None  # relative collective bytes
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_pods * self.chips_per_pod
+
+    def __post_init__(self) -> None:
+        if self.chips_per_pod and self.millitpu_per_pod:
+            raise ValueError("gang mixes whole-chip and fractional asks")
+        if self.millitpu_per_pod and self.num_pods != 1:
+            raise ValueError("fractional requests are single-pod")
+        if self.millitpu_per_pod >= MILLICHIPS_PER_CHIP:
+            raise ValueError("millitpu >= 1000 must be a whole-chip ask")
+
+
+@dataclass
+class PodAssignment:
+    pod_index: int       # gang index == TPU_WORKER_ID
+    node_name: str
+    host_id: int
+    chips: list[AllocatedChip] = field(default_factory=list)
+
+
+@dataclass
+class GangAssignment:
+    slice_id: str
+    pods: list[PodAssignment]
+    locality: float
+    score: float
+    placement: Placement | None = None
+    logical_order: list[Coord] = field(default_factory=list)
+
+    def to_allocations(self, coordinator_address: str,
+                       worker_hostnames: list[str]) -> list[Allocation]:
+        return [
+            Allocation(
+                node_name=p.node_name,
+                slice_id=self.slice_id,
+                chips=list(p.chips),
+                worker_id=p.pod_index,
+                num_workers=len(self.pods),
+                coordinator_address=coordinator_address,
+                worker_hostnames=worker_hostnames,
+            )
+            for p in self.pods
+        ]
+
+
+class SliceState:
+    """Mutable occupancy of one slice, assembled from node advertisements.
+
+    Reference parity: ``NodeInfo{Capacity, Allocatable, Used}`` (SURVEY.md
+    §3) — except a TPU "allocatable unit" is a coord in a mesh shared by
+    many nodes (hosts), so occupancy is per-coord millichips.
+    """
+
+    def __init__(self, slice_id: str, spec: TopologySpec):
+        self.slice_id = slice_id
+        self.spec = spec
+        self.topo = TpuTopology.build(spec)
+        self.node_of_host: dict[int, str] = {}
+        self.ip_of_host: dict[int, str] = {}
+        self.available: set[Coord] = set()     # advertised by some node
+        self.unhealthy: set[Coord] = set()
+        self.local_index: dict[Coord, int] = {}
+        self.used_millichips: dict[Coord, int] = {}
+
+    @classmethod
+    def from_advertisements(
+        cls, advs: list[NodeAdvertisement]
+    ) -> "SliceState":
+        if not advs:
+            raise ValueError("no advertisements")
+        first = advs[0]
+        if len({a.slice_id for a in advs}) != 1:
+            raise ValueError("advertisements span slices")
+        spec = TopologySpec(
+            name=first.slice_type, generation=first.slice_type.split("-")[0],
+            mesh_shape=first.mesh_shape, wrap=first.wrap,
+            host_block=first.host_block)
+        st = cls(first.slice_id, spec)
+        for a in advs:
+            st.node_of_host[a.host_id] = a.node_name
+            st.ip_of_host[a.host_id] = a.internal_ip
+            for c in a.chips:
+                st.available.add(c.coord)
+                st.local_index[c.coord] = c.local_index
+                if not c.healthy:
+                    st.unhealthy.add(c.coord)
+        return st
+
+    # -- occupancy -------------------------------------------------------
+
+    def blocked_for_whole(self) -> set[Coord]:
+        """Coords unusable for whole-chip placement: any current use,
+        unhealthy, or not advertised (host missing)."""
+        blocked = {c for c, u in self.used_millichips.items() if u > 0}
+        blocked |= self.unhealthy
+        all_coords = {ch.coord for ch in self.topo.chips}
+        blocked |= all_coords - self.available
+        return blocked
+
+    def free_millichips(self, coord: Coord) -> int:
+        if coord not in self.available or coord in self.unhealthy:
+            return 0
+        return MILLICHIPS_PER_CHIP - self.used_millichips.get(coord, 0)
+
+    def take(self, chips: list[AllocatedChip]) -> None:
+        for ch in chips:
+            newu = self.used_millichips.get(ch.coord, 0) + ch.millichips
+            if newu > MILLICHIPS_PER_CHIP:
+                raise ValueError(f"chip {ch.coord} over-allocated: {newu}")
+            self.used_millichips[ch.coord] = newu
+
+    def release(self, chips: list[AllocatedChip]) -> None:
+        for ch in chips:
+            cur = self.used_millichips.get(ch.coord, 0) - ch.millichips
+            if cur < 0:
+                raise ValueError(f"chip {ch.coord} over-released")
+            self.used_millichips[ch.coord] = cur
+
+    def fill_fraction(self) -> float:
+        cap = len(self.available) * MILLICHIPS_PER_CHIP
+        if not cap:
+            return 1.0
+        return sum(self.used_millichips.values()) / cap
+
+    def _alloc_chip(self, coord: Coord, millichips: int) -> AllocatedChip:
+        return AllocatedChip(coord=coord,
+                             local_index=self.local_index[coord],
+                             millichips=millichips)
+
+
+# ---------------------------------------------------------------------------
+# Ordering helpers specific to gang chunking
+# ---------------------------------------------------------------------------
+
+def _gilbert2d(w: int, h: int):
+    """Generalized Hilbert curve over a w×h grid: yields (x, y) visiting
+    every cell with consecutive cells adjacent and strong locality at all
+    scales — consecutive groups of blocks stay compact, which is what lets
+    a tp ring spanning several host blocks close into a physical cycle."""
+    def gen(x, y, ax, ay, bx, by):
+        wl = abs(ax + ay)
+        hl = abs(bx + by)
+        dax, day = (ax > 0) - (ax < 0), (ay > 0) - (ay < 0)
+        dbx, dby = (bx > 0) - (bx < 0), (by > 0) - (by < 0)
+        if hl == 1:
+            for _ in range(wl):
+                yield (x, y)
+                x, y = x + dax, y + day
+            return
+        if wl == 1:
+            for _ in range(hl):
+                yield (x, y)
+                x, y = x + dbx, y + dby
+            return
+        ax2, ay2 = ax // 2, ay // 2
+        bx2, by2 = bx // 2, by // 2
+        w2 = abs(ax2 + ay2)
+        h2 = abs(bx2 + by2)
+        if 2 * wl > 3 * hl:
+            if w2 % 2 and wl > 2:
+                ax2, ay2 = ax2 + dax, ay2 + day
+            yield from gen(x, y, ax2, ay2, bx, by)
+            yield from gen(x + ax2, y + ay2, ax - ax2, ay - ay2, bx, by)
+        else:
+            if h2 % 2 and hl > 2:
+                bx2, by2 = bx2 + dbx, by2 + dby
+            yield from gen(x, y, bx2, by2, ax2, ay2)
+            yield from gen(x + bx2, y + by2, ax, ay, bx - bx2, by - by2)
+            yield from gen(x + (ax - dax) + (bx2 - dbx),
+                           y + (ay - day) + (by2 - dby),
+                           -bx2, -by2, -(ax - ax2), -(ay - ay2))
+    if w >= h:
+        yield from gen(0, 0, w, 0, 0, h)
+    else:
+        yield from gen(0, 0, 0, h, w, 0)
+
+
+def _block_cycle_options(coords: list[Coord]) -> list[list[Coord]]:
+    """All oriented Hamiltonian walks of one host block that downstream
+    chunking may use (2x2 blocks: 4 rotations × 2 directions of the cycle)."""
+    if len(coords) == 4:
+        s = sorted(coords)
+        base = [s[0], s[1], s[3], s[2]]  # the 2x2 cycle
+        outs = []
+        for rot in range(4):
+            r = base[rot:] + base[:rot]
+            outs.append(r)
+            outs.append([r[0]] + list(reversed(r[1:])))
+        return outs
+    return [sorted(coords)]
+
+
+def _dist(a: Coord, b: Coord) -> int:
+    return sum(abs(a[i] - b[i]) for i in range(3))
+
+
+def _orient_rings(blocks: list[list[Coord]], close: bool = False) -> list[Coord]:
+    """Chain per-block chip cycles by dynamic programming: choose each
+    block's orientation so entry chips sit next to the previous block's
+    exit chip (Viterbi over ≤8 orientations/block).  With ``close``, also
+    optimize the wrap transition last-exit → first-entry, turning the whole
+    sequence into a physical cycle — what lets a collective ring spanning
+    several host blocks run at 100% ICI locality on an unwrapped mesh."""
+    options = [_block_cycle_options(b) for b in blocks]
+    if len(blocks) == 1:
+        return list(options[0][0])
+
+    def trans_cost(prev_opt: list[Coord], nxt_opt: list[Coord]) -> int:
+        d = _dist(prev_opt[-1], nxt_opt[0])
+        return 0 if d == 1 else d
+
+    best_total, best_path = None, None
+    starts = options[0] if close else options[0][:1]
+    for start in starts:
+        # cost[j] = best cost ending with option j of current block
+        cost = {0: 0}
+        back: list[dict[int, int]] = []
+        prev_opts = [start]
+        for i in range(1, len(blocks)):
+            ncost: dict[int, int] = {}
+            nback: dict[int, int] = {}
+            for j, opt in enumerate(options[i]):
+                bestc, bestj = None, None
+                for pj, pcost in cost.items():
+                    c = pcost + trans_cost(prev_opts[pj], opt)
+                    if bestc is None or c < bestc:
+                        bestc, bestj = c, pj
+                ncost[j] = bestc
+                nback[j] = bestj
+            back.append(nback)
+            cost = ncost
+            prev_opts = options[i]
+        for j, c in cost.items():
+            total = c
+            if close:
+                total += trans_cost(options[-1][j], start)
+            if best_total is None or total < best_total:
+                # backtrack
+                path = [j]
+                for nb in reversed(back):
+                    path.append(nb[path[-1]])
+                path.reverse()
+                chosen = [start] + [options[i][path[i]]
+                                    for i in range(1, len(blocks))]
+                best_total, best_path = total, chosen
+    out: list[Coord] = []
+    for opt in best_path:
+        out.extend(opt)
+    return out
+
+
+def _block_sequences(topo: TpuTopology,
+                     placement: Placement) -> list[list[list[Coord]]]:
+    """Orderings of the placement's host blocks: snake (two axes) and
+    generalized-Hilbert traversals of the block grid."""
+    by_host: dict[int, list[Coord]] = {}
+    for c in placement.coords:
+        by_host.setdefault(topo.chip_at(c).host_id, []).append(c)
+    entries = [(topo.hosts[h].block_origin, coords)
+               for h, coords in by_host.items()]
+    seqs: list[list[list[Coord]]] = []
+    for major in (0, 1):
+        minor = 1 - major
+        majors = sorted({o[major] for o, _ in entries})
+        seq: list[list[Coord]] = []
+        for i, m in enumerate(majors):
+            line = [e for e in entries if e[0][major] == m]
+            line.sort(key=lambda e: e[0][minor])
+            if i % 2 == 1:
+                line.reverse()
+            seq.extend(blk for _, blk in line)
+        seqs.append(seq)
+    origins = sorted({o for o, _ in entries})
+    bxs = sorted({o[0] for o in origins})
+    bys = sorted({o[1] for o in origins})
+    if len(origins) == len(bxs) * len(bys) and len(origins) > 2:
+        by_origin = {o: blk for o, blk in entries}
+        seq = []
+        for gx, gy in _gilbert2d(len(bxs), len(bys)):
+            key = (bxs[gx], bys[gy], origins[0][2])
+            if key not in by_origin:
+                seq = []
+                break
+            seq.append(by_origin[key])
+        if seq:
+            seqs.append(seq)
+    return seqs
+
+
+def _block_orders(topo: TpuTopology, placement: Placement,
+                  ring_span: int | None = None) -> list[list[Coord]]:
+    """Chip orders built from block sequences.  With ``ring_span`` (chips
+    in the workload's fastest logical axis), blocks are grouped so each
+    ring's span of blocks is closed into a physical cycle — e.g. a tp=16
+    ring over four 2x2 host blocks becomes a 16-chip ICI cycle."""
+    orders: list[list[Coord]] = []
+    for seq in _block_sequences(topo, placement):
+        orders.append(_orient_rings(seq, close=len(seq) > 2))
+        if ring_span:
+            cph = len(seq[0])
+            span_blocks = ring_span // cph if ring_span % cph == 0 else 0
+            if span_blocks > 1 and len(seq) % span_blocks == 0:
+                grouped: list[Coord] = []
+                for g in range(0, len(seq), span_blocks):
+                    grouped.extend(
+                        _orient_rings(seq[g:g + span_blocks], close=True))
+                orders.append(grouped)
+    return orders
+
+
+def _chunks_host_local(topo: TpuTopology, order: list[Coord], c: int) -> bool:
+    for i in range(0, len(order), c):
+        hosts = {topo.chip_at(x).host_id for x in order[i:i + c]}
+        if len(hosts) != 1:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# The allocator
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Candidate:
+    slice_state: SliceState
+    placement: Placement
+    order: list[Coord]
+    locality: float
+    score: float
+
+
+class GangAllocator:
+    """Pure-function fit/score/assign over SliceStates (no I/O) — the same
+    testability property the reference's allocator had (SURVEY.md §5)."""
+
+    def __init__(self, max_placements_per_shape: int = 64,
+                 locality_weight: float = 0.6, frag_weight: float = 0.25,
+                 fill_weight: float = 0.15):
+        self.max_placements_per_shape = max_placements_per_shape
+        self.locality_weight = locality_weight
+        self.frag_weight = frag_weight
+        self.fill_weight = fill_weight
+
+    # -- public API ------------------------------------------------------
+
+    def find_assignment(self, slices: list[SliceState],
+                        req: GangRequest) -> GangAssignment | None:
+        if req.millitpu_per_pod:
+            return self._find_fractional(slices, req)
+        best: GangAssignment | None = None
+        for st in slices:
+            cand = self._best_candidate_in_slice(st, req)
+            if cand and (best is None or cand.score > best.score):
+                best = cand
+        return best
+
+    def commit(self, slices: dict[str, SliceState],
+               assignment: GangAssignment) -> None:
+        """TakePodResources (SURVEY.md §4.2): mutate occupancy atomically."""
+        st = slices[assignment.slice_id]
+        for p in assignment.pods:
+            st.take(p.chips)
+
+    def rollback(self, slices: dict[str, SliceState],
+                 assignment: GangAssignment) -> None:
+        """ReturnPodResources (SURVEY.md §4.4)."""
+        st = slices[assignment.slice_id]
+        for p in assignment.pods:
+            st.release(p.chips)
+
+    # -- whole-chip path -------------------------------------------------
+
+    def _best_candidate_in_slice(self, st: SliceState,
+                                 req: GangRequest) -> GangAssignment | None:
+        total = req.total_chips
+        if total == 0 or total > len(st.available):
+            return None
+        cph = st.spec.chips_per_host
+        if req.chips_per_pod > cph:
+            return None  # a pod cannot span hosts
+        blocked = st.blocked_for_whole()
+        axes = req.mesh_axes or {"dp": total}
+        best: _Candidate | None = None
+        for shape in subslice_shapes(total, st.spec.mesh_shape):
+            placements = find_free_placements(
+                st.topo, blocked, shape,
+                limit=self.max_placements_per_shape)
+            for pl in placements:
+                cand = self._score_placement(st, pl, req, axes)
+                if cand and (best is None or cand.score > best.score):
+                    best = cand
+        if best is None:
+            # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) fall back
+            # to a connected free set — the reference's group allocator had
+            # the same flexibility since groups weren't geometric.
+            cand = self._connected_candidate(st, req, blocked, axes)
+            if cand is not None:
+                best = cand
+        if best is None:
+            return None
+        return self._to_assignment(best, req)
+
+    def _connected_candidate(self, st: SliceState, req: GangRequest,
+                             blocked: set[Coord],
+                             axes: dict[str, int]) -> _Candidate | None:
+        """BFS-grow a connected set of free chips, chunked host-locally."""
+        total = req.total_chips
+        c = req.chips_per_pod
+        free = sorted({ch.coord for ch in st.topo.chips} - blocked)
+        for start in free:
+            seen = {start}
+            frontier = [start]
+            region: list[Coord] = []
+            while frontier and len(region) + len(frontier) <= len(free):
+                frontier.sort()
+                nxt = frontier.pop(0)
+                region.append(nxt)
+                if len(region) >= total:
+                    break
+                for nb in st.topo.neighbors(nxt):
+                    if nb not in seen and nb not in blocked:
+                        seen.add(nb)
+                        frontier.append(nb)
+            if len(region) < total:
+                continue
+            # chunk host-locally: pods take chips host by host
+            by_host: dict[int, list[Coord]] = {}
+            for x in region:
+                by_host.setdefault(st.topo.chip_at(x).host_id, []).append(x)
+            order: list[Coord] = []
+            chunks_formed = 0
+            for hid in sorted(by_host):
+                chips = sorted(by_host[hid])
+                usable = (len(chips) // c) * c
+                take = min(usable, total - len(order))
+                order.extend(chips[:take])
+                chunks_formed += take // c
+                if len(order) >= total:
+                    break
+            if len(order) != total or chunks_formed != req.num_pods:
+                continue
+            loc = evaluate_order(st.topo, order, axes, req.axis_weights)
+            pl = Placement(origin=min(order), shape=(0, 0, 0),
+                           coords=tuple(order))
+            frag = fragmentation_score(st.topo, blocked, pl)
+            score = 10.0 * (self.locality_weight * loc
+                            + self.frag_weight * frag
+                            + self.fill_weight * st.fill_fraction())
+            return _Candidate(slice_state=st, placement=pl, order=order,
+                              locality=loc, score=score)
+        return None
+
+    def _score_placement(self, st: SliceState, pl: Placement,
+                         req: GangRequest,
+                         axes: dict[str, int]) -> _Candidate | None:
+        c = req.chips_per_pod
+        ring_span = list(axes.values())[-1] if axes else None
+        orders = [o for o in
+                  candidate_orders(pl) + _block_orders(st.topo, pl, ring_span)
+                  if _chunks_host_local(st.topo, o, c)]
+        if not orders:
+            return None
+        best_order, best_loc = None, -1.0
+        for o in orders:
+            loc = evaluate_order(st.topo, o, axes, req.axis_weights)
+            if loc > best_loc:
+                best_order, best_loc = o, loc
+        frag = fragmentation_score(st.topo, st.blocked_for_whole(), pl)
+        fill = st.fill_fraction()
+        score = 10.0 * (self.locality_weight * best_loc
+                        + self.frag_weight * frag
+                        + self.fill_weight * fill)
+        return _Candidate(slice_state=st, placement=pl, order=best_order,
+                          locality=best_loc, score=score)
+
+    def _to_assignment(self, cand: _Candidate,
+                       req: GangRequest) -> GangAssignment:
+        st = cand.slice_state
+        c = req.chips_per_pod
+        pods: list[PodAssignment] = []
+        for k in range(req.num_pods):
+            chunk = cand.order[k * c:(k + 1) * c]
+            host_id = st.topo.chip_at(chunk[0]).host_id
+            pods.append(PodAssignment(
+                pod_index=k,
+                node_name=st.node_of_host.get(host_id, f"host-{host_id}"),
+                host_id=host_id,
+                chips=[st._alloc_chip(x, MILLICHIPS_PER_CHIP)
+                       for x in chunk]))
+        return GangAssignment(
+            slice_id=st.slice_id, pods=pods, locality=cand.locality,
+            score=cand.score, placement=cand.placement,
+            logical_order=cand.order)
+
+    # -- fractional path -------------------------------------------------
+
+    def _find_fractional(self, slices: list[SliceState],
+                         req: GangRequest) -> GangAssignment | None:
+        """Best-fit-decreasing: prefer the most-used chip that still fits,
+        keeping whole chips free for slice placements (BASELINE config 5)."""
+        need = req.millitpu_per_pod
+        best: tuple[int, SliceState, Coord] | None = None
+        for st in slices:
+            for coord in sorted(st.available):
+                free = st.free_millichips(coord)
+                used = st.used_millichips.get(coord, 0)
+                if free >= need:
+                    # prefer max used (tightest fit); tie-break stable coord
+                    if best is None or used > best[0]:
+                        best = (used, st, coord)
+        if best is None:
+            return None
+        _, st, coord = best
+        host_id = st.topo.chip_at(coord).host_id
+        pod = PodAssignment(
+            pod_index=0,
+            node_name=st.node_of_host.get(host_id, f"host-{host_id}"),
+            host_id=host_id,
+            chips=[st._alloc_chip(coord, need)])
+        return GangAssignment(slice_id=st.slice_id, pods=[pod],
+                              locality=1.0, score=5.0 + 5.0 * (best[0] / MILLICHIPS_PER_CHIP))
+
+    # -- helpers for the scheduler --------------------------------------
+
+    @staticmethod
+    def coordinator_for(assignment: GangAssignment,
+                        slices: dict[str, SliceState]) -> tuple[str, list[str]]:
+        """(coordinator address, worker hostnames in worker order)."""
+        st = slices[assignment.slice_id]
+        hosts = [p.host_id for p in assignment.pods]
+        names = [st.node_of_host.get(h, f"host-{h}") for h in hosts]
+        ip0 = st.ip_of_host.get(hosts[0], "127.0.0.1")
+        return f"{ip0}:{COORDINATOR_PORT}", names

@@ -1,0 +1,280 @@
+"""Allocator tests — the reference's dominant test mode (SURVEY.md §5):
+synthetic cluster state × synthetic requests ⇒ assert fit/no-fit, chosen
+placement, scores.  Includes the property tests SURVEY.md §5 calls for:
+random meshes × random gangs ⇒ valid contiguous assignment, never
+double-booked."""
+
+import random
+
+import pytest
+
+from kubegpu_tpu.allocator import (
+    GangAllocator,
+    GangRequest,
+    SliceState,
+    best_logical_order,
+)
+from kubegpu_tpu.topology import get_topology
+from kubegpu_tpu.topology.slices import enumerate_placements
+from kubegpu_tpu.tpuplugin import MockBackend
+from kubegpu_tpu.tpuplugin.backend import MILLICHIPS_PER_CHIP
+
+
+def make_slice(slice_type: str, slice_id: str | None = None,
+               unhealthy: dict[int, set[int]] | None = None) -> SliceState:
+    from kubegpu_tpu.topology.mesh import TOPOLOGY_REGISTRY
+    spec = TOPOLOGY_REGISTRY[slice_type]
+    advs = [
+        MockBackend(slice_type, host_id=h, slice_id=slice_id,
+                    unhealthy_chips=(unhealthy or {}).get(h, set())).discover()
+        for h in range(spec.num_hosts)
+    ]
+    return SliceState.from_advertisements(advs)
+
+
+class TestSingleChip:
+    def test_one_chip_fits(self):
+        st = make_slice("v4-8")
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest("j", num_pods=1, chips_per_pod=1))
+        assert asg is not None
+        assert len(asg.pods) == 1
+        assert len(asg.pods[0].chips) == 1
+        assert asg.pods[0].chips[0].millichips == MILLICHIPS_PER_CHIP
+
+    def test_no_fit_when_full(self):
+        st = make_slice("v4-8")
+        alloc = GangAllocator()
+        slices = {st.slice_id: st}
+        for i in range(4):
+            a = alloc.find_assignment([st], GangRequest(f"j{i}", 1, 1))
+            assert a is not None
+            alloc.commit(slices, a)
+        assert alloc.find_assignment([st], GangRequest("j5", 1, 1)) is None
+
+    def test_pod_cannot_span_hosts(self):
+        st = make_slice("v5e-16")  # 4 chips per host
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest("j", num_pods=1, chips_per_pod=8))
+        assert asg is None  # 8 > chips_per_host
+
+    def test_unhealthy_chip_avoided(self):
+        st = make_slice("v4-8", unhealthy={0: {0, 1, 2}})
+        asg = GangAllocator().find_assignment([st], GangRequest("j", 1, 1))
+        assert asg is not None
+        assert asg.pods[0].chips[0].coord not in st.unhealthy
+        # only one healthy chip → a 2-chip pod must fail
+        assert GangAllocator().find_assignment(
+            [st], GangRequest("j2", 1, 2)) is None
+
+
+class TestGangs:
+    def test_4pod_dp_gang_on_v4_8(self):
+        """BASELINE config 3: 4-pod DP gang on one v4-8 host."""
+        st = make_slice("v4-8")
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest("dpjob", num_pods=4, chips_per_pod=1,
+                              mesh_axes={"dp": 4}))
+        assert asg is not None
+        assert [p.pod_index for p in asg.pods] == [0, 1, 2, 3]
+        coords = [p.chips[0].coord for p in asg.pods]
+        assert len(set(coords)) == 4
+        # 2x2 ring order keeps the DP ring fully ICI-local
+        assert asg.locality == pytest.approx(1.0)
+
+    def test_gang_atomicity(self):
+        """5-chip ask on a 4-chip slice: nothing is allocated."""
+        st = make_slice("v4-8")
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest("big", num_pods=5, chips_per_pod=1))
+        assert asg is None
+        assert sum(st.used_millichips.values()) == 0
+
+    def test_multihost_gang_v5e16(self):
+        """BASELINE config 4 shape: 4 pods × 4 chips = whole v5e-16."""
+        st = make_slice("v5e-16")
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest("llama", num_pods=4, chips_per_pod=4,
+                              mesh_axes={"dp": 4, "tp": 4}))
+        assert asg is not None
+        # each pod's 4 chips on one host
+        for p in asg.pods:
+            host_ids = {st.topo.chip_at(c.coord).host_id for c in p.chips}
+            assert len(host_ids) == 1
+        # distinct hosts for 4x4-chip pods
+        assert len({p.host_id for p in asg.pods}) == 4
+        # worker order: node names in worker order are deterministic
+        assert [p.pod_index for p in asg.pods] == [0, 1, 2, 3]
+
+    def test_gang_respects_occupancy(self):
+        st = make_slice("v5e-16")
+        alloc = GangAllocator()
+        slices = {st.slice_id: st}
+        a1 = alloc.find_assignment(
+            [st], GangRequest("a", num_pods=2, chips_per_pod=4))
+        alloc.commit(slices, a1)
+        a2 = alloc.find_assignment(
+            [st], GangRequest("b", num_pods=2, chips_per_pod=4))
+        assert a2 is not None
+        alloc.commit(slices, a2)
+        used1 = {c.coord for p in a1.pods for c in p.chips}
+        used2 = {c.coord for p in a2.pods for c in p.chips}
+        assert not (used1 & used2)
+        # slice now full
+        assert alloc.find_assignment(
+            [st], GangRequest("c", num_pods=1, chips_per_pod=1)) is None
+
+    def test_rollback(self):
+        st = make_slice("v4-8")
+        alloc = GangAllocator()
+        slices = {st.slice_id: st}
+        a = alloc.find_assignment([st], GangRequest("a", 4, 1))
+        alloc.commit(slices, a)
+        alloc.rollback(slices, a)
+        assert sum(st.used_millichips.values()) == 0
+
+    def test_coordinator_and_hostnames(self):
+        st = make_slice("v5e-16")
+        alloc = GangAllocator()
+        asg = alloc.find_assignment(
+            [st], GangRequest("j", num_pods=4, chips_per_pod=4))
+        addr, names = GangAllocator.coordinator_for(
+            asg, {st.slice_id: st})
+        assert addr.endswith(":8476")
+        assert len(names) == 4
+        assert names[0] == asg.pods[0].node_name
+
+
+class TestLocalityScoring:
+    def test_compact_preferred_on_v5e64(self):
+        """A (4,4) logical mesh of 1-chip pods must land on a 4x4 physical
+        block (grid mapping: 0.75 locality) — not a 1x16 line (0.375)."""
+        st = make_slice("v5e-64")
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest("j", num_pods=16, chips_per_pod=1,
+                              mesh_axes={"dp": 4, "tp": 4}))
+        assert asg is not None
+        assert set(asg.placement.shape[:2]) == {4}
+        assert asg.locality >= 0.7
+
+    def test_tp_heavy_weighting_gets_local_tp(self):
+        st = make_slice("v5e-64")
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest(
+                "llama", num_pods=16, chips_per_pod=4,
+                mesh_axes={"dp": 4, "tp": 16},
+                axis_weights={"tp": 10.0, "dp": 1.0}))
+        assert asg is not None
+        assert asg.locality > 0.9  # the ≥90% north-star bar
+
+    def test_best_logical_order_closes_dp_ring(self):
+        topo = get_topology("v5e-16")
+        pl = enumerate_placements(topo, (4, 4, 1))[0]
+        order, loc = best_logical_order(topo, pl, {"dp": 16})
+        assert loc == pytest.approx(1.0)  # snake closes the cycle
+        assert len(order) == 16
+
+
+class TestFractional:
+    def test_fractional_binpacks(self):
+        """BASELINE config 5: two fractional jobs share one chip."""
+        st = make_slice("v4-8")
+        alloc = GangAllocator()
+        slices = {st.slice_id: st}
+        a1 = alloc.find_assignment(
+            [st], GangRequest("f1", millitpu_per_pod=400))
+        alloc.commit(slices, a1)
+        a2 = alloc.find_assignment(
+            [st], GangRequest("f2", millitpu_per_pod=500))
+        alloc.commit(slices, a2)
+        assert a1.pods[0].chips[0].coord == a2.pods[0].chips[0].coord
+        # 3 whole chips still free for slices
+        asg = alloc.find_assignment(
+            [st], GangRequest("whole", num_pods=3, chips_per_pod=1))
+        assert asg is not None
+
+    def test_fractional_no_overcommit(self):
+        st = make_slice("v4-8")
+        alloc = GangAllocator()
+        slices = {st.slice_id: st}
+        for i in range(4 * 2):  # 8 x 500 fills all 4 chips
+            a = alloc.find_assignment(
+                [st], GangRequest(f"f{i}", millitpu_per_pod=500))
+            assert a is not None
+            alloc.commit(slices, a)
+        assert alloc.find_assignment(
+            [st], GangRequest("f9", millitpu_per_pod=500)) is None
+
+    def test_fractional_request_validation(self):
+        with pytest.raises(ValueError):
+            GangRequest("x", num_pods=2, millitpu_per_pod=500)
+        with pytest.raises(ValueError):
+            GangRequest("x", chips_per_pod=1, millitpu_per_pod=500)
+        with pytest.raises(ValueError):
+            GangRequest("x", millitpu_per_pod=1500)
+
+
+class TestMultiSlice:
+    def test_best_fit_across_slices(self):
+        """Prefer filling the fuller slice (bin packing)."""
+        s1 = make_slice("v5e-16", slice_id="s1")
+        s2 = make_slice("v5e-16", slice_id="s2")
+        alloc = GangAllocator()
+        slices = {"s1": s1, "s2": s2}
+        a = alloc.find_assignment(
+            [s1, s2], GangRequest("warm", num_pods=2, chips_per_pod=4))
+        alloc.commit(slices, a)
+        warm = a.slice_id
+        b = alloc.find_assignment(
+            [s1, s2], GangRequest("next", num_pods=1, chips_per_pod=4))
+        assert b.slice_id == warm  # fill-weight steers to the used slice
+
+    def test_spillover_when_full(self):
+        s1 = make_slice("v4-8", slice_id="s1")
+        s2 = make_slice("v4-8", slice_id="s2")
+        alloc = GangAllocator()
+        slices = {"s1": s1, "s2": s2}
+        a = alloc.find_assignment([s1, s2], GangRequest("a", 4, 1))
+        alloc.commit(slices, a)
+        b = alloc.find_assignment([s1, s2], GangRequest("b", 4, 1))
+        assert b is not None
+        assert b.slice_id != a.slice_id
+
+
+class TestProperties:
+    """SURVEY.md §5 (a): random meshes × random gangs ⇒ always valid."""
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_workload_never_double_books(self, seed):
+        rng = random.Random(seed)
+        slice_type = rng.choice(["v4-8", "v5e-8", "v5e-16", "v5e-64"])
+        st = make_slice(slice_type)
+        alloc = GangAllocator(max_placements_per_shape=16)
+        slices = {st.slice_id: st}
+        live: list = []
+        for step in range(30):
+            if live and rng.random() < 0.4:
+                asg = live.pop(rng.randrange(len(live)))
+                alloc.rollback(slices, asg)
+                continue
+            cph = st.spec.chips_per_host
+            c = rng.choice([1, 2, cph])
+            max_pods = st.spec.num_chips // c
+            p = rng.randint(1, max(1, max_pods))
+            asg = alloc.find_assignment(
+                [st], GangRequest(f"g{step}", num_pods=p, chips_per_pod=c))
+            if asg is None:
+                continue
+            # validity: right pod count, chunk sizes, host-locality
+            assert len(asg.pods) == p
+            for pa in asg.pods:
+                assert len(pa.chips) == c
+                hosts = {st.topo.chip_at(ch.coord).host_id
+                         for ch in pa.chips}
+                assert len(hosts) == 1
+            alloc.commit(slices, asg)  # raises on double-book
+            live.append(asg)
+        # conservation: releasing everything zeroes occupancy
+        for asg in live:
+            alloc.rollback(slices, asg)
+        assert all(v == 0 for v in st.used_millichips.values())
