@@ -49,12 +49,24 @@ class GangRequest:
         return self.num_pods * self.chips_per_pod
 
     def __post_init__(self) -> None:
+        if self.num_pods < 1:
+            raise ValueError("num_pods must be >= 1")
+        if self.chips_per_pod < 0 or self.millitpu_per_pod < 0:
+            raise ValueError("negative device request")
         if self.chips_per_pod and self.millitpu_per_pod:
             raise ValueError("gang mixes whole-chip and fractional asks")
         if self.millitpu_per_pod and self.num_pods != 1:
             raise ValueError("fractional requests are single-pod")
         if self.millitpu_per_pod >= MILLICHIPS_PER_CHIP:
             raise ValueError("millitpu >= 1000 must be a whole-chip ask")
+        if self.mesh_axes:
+            prod = 1
+            for v in self.mesh_axes.values():
+                prod *= v
+            if prod != self.total_chips:
+                raise ValueError(
+                    f"mesh_axes {self.mesh_axes} product {prod} != "
+                    f"total chips {self.total_chips}")
 
 
 @dataclass
@@ -162,6 +174,23 @@ class SliceState:
             if cur < 0:
                 raise ValueError(f"chip {ch.coord} over-released")
             self.used_millichips[ch.coord] = cur
+
+    def restricted_to_node(self, node_name: str) -> "SliceState":
+        """A view of this slice where only ``node_name``'s chips are
+        available — the per-node feasibility check the extender /filter
+        verb needs (a candidate node can only contribute its own chips)."""
+        host_ids = {h for h, n in self.node_of_host.items() if n == node_name}
+        view = SliceState(self.slice_id, self.spec)
+        view.node_of_host = dict(self.node_of_host)
+        view.ip_of_host = dict(self.ip_of_host)
+        node_coords = {self.topo.chips[i].coord
+                       for h in host_ids
+                       for i in self.topo.hosts[h].chip_indices}
+        view.available = self.available & node_coords
+        view.unhealthy = set(self.unhealthy)
+        view.local_index = dict(self.local_index)
+        view.used_millichips = dict(self.used_millichips)
+        return view
 
     def fill_fraction(self) -> float:
         cap = len(self.available) * MILLICHIPS_PER_CHIP
@@ -426,6 +455,7 @@ class GangAllocator:
         if req.chips_per_pod > cph:
             return None  # a pod cannot span hosts
         blocked = st.blocked_for_whole()
+        fill = st.fill_fraction()
         axes = req.mesh_axes or {"dp": total}
         best: _Candidate | None = None
         for shape in subslice_shapes(total, st.spec.mesh_shape):
@@ -433,7 +463,7 @@ class GangAllocator:
                 st.topo, blocked, shape,
                 limit=self.max_placements_per_shape)
             for pl in placements:
-                cand = self._score_placement(st, pl, req, axes)
+                cand = self._score_placement(st, pl, req, axes, blocked, fill)
                 if cand and (best is None or cand.score > best.score):
                     best = cand
         if best is None:
@@ -498,8 +528,9 @@ class GangAllocator:
         return None
 
     def _score_placement(self, st: SliceState, pl: Placement,
-                         req: GangRequest,
-                         axes: dict[str, int]) -> _Candidate | None:
+                         req: GangRequest, axes: dict[str, int],
+                         blocked: set[Coord],
+                         fill: float) -> _Candidate | None:
         c = req.chips_per_pod
         ring_span = list(axes.values())[-1] if axes else None
         orders = [o for o in
@@ -512,8 +543,7 @@ class GangAllocator:
             loc = evaluate_order(st.topo, o, axes, req.axis_weights)
             if loc > best_loc:
                 best_order, best_loc = o, loc
-        frag = fragmentation_score(st.topo, st.blocked_for_whole(), pl)
-        fill = st.fill_fraction()
+        frag = fragmentation_score(st.topo, blocked, pl)
         score = 10.0 * (self.locality_weight * best_loc
                         + self.frag_weight * frag
                         + self.fill_weight * fill)
@@ -571,10 +601,11 @@ class GangAllocator:
 
     @staticmethod
     def coordinator_for(assignment: GangAssignment,
-                        slices: dict[str, SliceState]) -> tuple[str, list[str]]:
+                        slices: dict[str, SliceState],
+                        port: int = COORDINATOR_PORT) -> tuple[str, list[str]]:
         """(coordinator address, worker hostnames in worker order)."""
         st = slices[assignment.slice_id]
         hosts = [p.host_id for p in assignment.pods]
         names = [st.node_of_host.get(h, f"host-{h}") for h in hosts]
         ip0 = st.ip_of_host.get(hosts[0], "127.0.0.1")
-        return f"{ip0}:{COORDINATOR_PORT}", names
+        return f"{ip0}:{port}", names

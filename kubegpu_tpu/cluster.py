@@ -1,0 +1,142 @@
+"""SimCluster: the in-process simulated control plane (SURVEY.md §5 (c)).
+
+Wires fake apiserver + N node agents (one per TPU host VM, mock backend)
++ the device scheduler into one steppable cluster, so all five BASELINE
+configs run end-to-end through the real scheduling/injection code — only
+the transports (gRPC/HTTP) are collapsed into function calls.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+from kubegpu_tpu.crishim import FakeRuntime, NodeAgent, SubprocessRuntime
+from kubegpu_tpu.kubemeta import (
+    ContainerSpec,
+    FakeApiServer,
+    GangSpec,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    ResourceRequests,
+    WatchEvent,
+)
+from kubegpu_tpu.kubemeta.codec import set_pod_gang, set_pod_mesh_axes
+from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
+from kubegpu_tpu.scheduler import DeviceScheduler
+from kubegpu_tpu.tpuplugin import mock_cluster
+
+_port_counter = itertools.count(0)
+
+
+def pick_coordinator_port() -> int:
+    """Distinct ports per cluster so parallel tests' jax.distributed
+    coordinators never collide."""
+    return 8476 + (next(_port_counter) % 500)
+
+
+def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
+            gang: GangSpec | None = None,
+            mesh_axes: dict[str, int] | None = None,
+            command: list[str] | None = None,
+            env: dict[str, str] | None = None) -> Pod:
+    """Pod-spec builder — the user surface (reference: example/ YAML)."""
+    pod = Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(containers=[ContainerSpec(
+            name="main",
+            command=command or [],
+            env=env or {},
+            resources=ResourceRequests(tpu_chips=chips, millitpu=millitpu),
+        )]),
+    )
+    if gang is not None:
+        set_pod_gang(pod, gang)
+    if mesh_axes is not None:
+        set_pod_mesh_axes(pod, mesh_axes)
+    return pod
+
+
+class SimCluster:
+    def __init__(self, slice_types: list[str], real_processes: bool = False,
+                 extra_env: dict[str, str] | None = None):
+        self.api = FakeApiServer()
+        self.metrics = MetricsRegistry()
+        self.trace = ScheduleTrace()
+        if real_processes:
+            self.runtime = SubprocessRuntime(extra_env=extra_env)
+        else:
+            self.runtime = FakeRuntime()
+        self.agents = [NodeAgent(self.api, b, self.runtime)
+                       for b in mock_cluster(slice_types)]
+        for a in self.agents:
+            a.register()
+        self.scheduler = DeviceScheduler(
+            self.api, metrics=self.metrics, trace=self.trace,
+            coordinator_port=pick_coordinator_port())
+        self._unsub = self.api.watch(self._on_event)
+
+    # -- lifecycle events: free resources when pods finish/disappear -----
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        if ev.kind != "Pod":
+            return
+        pod = ev.obj
+        if ev.type == "DELETED" or (
+                ev.type == "MODIFIED"
+                and pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)):
+            self.scheduler.return_pod_resources(pod.name)
+
+    # -- driving ---------------------------------------------------------
+
+    def submit(self, *pods: Pod) -> None:
+        for p in pods:
+            self.api.create("Pod", p)
+
+    def step(self):
+        """One control-plane tick: schedule pending, start bound pods."""
+        result = self.scheduler.run_once()
+        started = []
+        for a in self.agents:
+            started.extend(a.run_once())
+        return result, started
+
+    def reap(self, timeout: float | None = None) -> dict[str, int]:
+        codes: dict[str, int] = {}
+        for a in self.agents:
+            codes.update(a.reap(timeout=timeout))
+        return codes
+
+    def run_to_completion(self, timeout_s: float = 120.0,
+                          tick_s: float = 0.02) -> dict[str, int]:
+        """Step until every pod is terminal (or unschedulable pods remain
+        and nothing is running).  Returns pod → exit code."""
+        deadline = time.monotonic() + timeout_s
+        exit_codes: dict[str, int] = {}
+        while time.monotonic() < deadline:
+            self.step()
+            exit_codes.update(self.reap(timeout=tick_s))
+            pods = self.api.list("Pod")
+            if all(p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+                   for p in pods):
+                return exit_codes
+            running = any(p.status.phase == PodPhase.RUNNING for p in pods)
+            pending = [p for p in pods if p.status.phase == PodPhase.PENDING]
+            if pending and not running:
+                # give held gangs a chance; bail only if truly stuck
+                r, _ = self.step()
+                if not r.scheduled and not running and not r.held:
+                    break
+            time.sleep(0 if running else tick_s)
+        return exit_codes
+
+    def pod_phase(self, name: str) -> PodPhase:
+        return self.api.get("Pod", name).status.phase
+
+    def close(self) -> None:
+        self._unsub()
+        for a in self.agents:
+            for h in a.handles.values():
+                h.kill()

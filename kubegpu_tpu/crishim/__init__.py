@@ -1,0 +1,26 @@
+"""Runtime-injection layer — reference: ``crishim`` (SURVEY.md §3, §4.3).
+
+The reference interposed a gRPC CRI server between kubelet and the real
+container runtime, rewriting ``CreateContainer`` with device env/mounts.
+KubeTPU keeps the exact seam: ``CriShim.create_container`` reads the pod's
+allocation annotation, asks the device backend for the TPU env
+(``TPU_VISIBLE_CHIPS``/``TPU_WORKER_ID``/coordinator bootstrap), rewrites
+the container spec, and forwards to a runtime.  ``SubprocessRuntime``
+actually launches workload processes with that env; ``FakeRuntime`` records
+calls for scheduler-side tests.  ``NodeAgent`` plays kubelet+advertiser:
+periodic Node advertisement patches and reacting to pods bound here.
+"""
+
+from kubegpu_tpu.crishim.runtime import (
+    ContainerHandle,
+    ContainerRuntime,
+    FakeRuntime,
+    SubprocessRuntime,
+)
+from kubegpu_tpu.crishim.shim import CriShim
+from kubegpu_tpu.crishim.agent import NodeAgent
+
+__all__ = [
+    "ContainerHandle", "ContainerRuntime", "FakeRuntime",
+    "SubprocessRuntime", "CriShim", "NodeAgent",
+]

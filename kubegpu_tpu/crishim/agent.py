@@ -1,0 +1,88 @@
+"""Node agent: advertiser + kubelet-ish pod lifecycle.
+
+Reference parity: the ``kubeadvertise`` loop PATCHing the Node object
+(SURVEY.md §4.1) plus the kubelet role in §4.3 (seeing pods bound to this
+node and calling the CRI).  One agent per (simulated) TPU host VM.
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.crishim.runtime import ContainerHandle, ContainerRuntime
+from kubegpu_tpu.crishim.shim import CriShim
+from kubegpu_tpu.kubemeta import (
+    FakeApiServer,
+    Node,
+    NotFound,
+    ObjectMeta,
+    PodPhase,
+)
+from kubegpu_tpu.kubemeta.codec import (
+    DEVICE_INFO_KEY,
+    node_advertisement_to_annotation,
+)
+from kubegpu_tpu.tpuplugin.backend import DeviceBackend
+
+
+class NodeAgent:
+    def __init__(self, api: FakeApiServer, backend: DeviceBackend,
+                 runtime: ContainerRuntime):
+        self.api = api
+        self.backend = backend
+        self.adv = backend.discover()
+        self.node_name = self.adv.node_name
+        self.runtime = runtime
+        self.shim = CriShim(api, backend, self.node_name, runtime)
+        self.handles: dict[str, ContainerHandle] = {}  # pod name → handle
+
+    # -- advertisement (SURVEY.md §4.1) ---------------------------------
+
+    def register(self) -> None:
+        """Create the Node object if needed, then advertise capacity +
+        topology as an annotation."""
+        try:
+            self.api.get("Node", self.node_name)
+        except NotFound:
+            self.api.create("Node", Node(
+                metadata=ObjectMeta(name=self.node_name)))
+        self.advertise()
+
+    def advertise(self) -> None:
+        self.adv = self.backend.discover()  # re-enumerate (health may change)
+        self.api.patch_annotations(
+            "Node", self.node_name,
+            {DEVICE_INFO_KEY: node_advertisement_to_annotation(self.adv)})
+
+    # -- pod lifecycle (SURVEY.md §4.3) ---------------------------------
+
+    def run_once(self) -> list[ContainerHandle]:
+        """Start containers for pods newly bound to this node."""
+        started: list[ContainerHandle] = []
+        for pod in self.api.list("Pod"):
+            if (pod.spec.node_name == self.node_name
+                    and pod.status.phase == PodPhase.SCHEDULED
+                    and pod.name not in self.handles):
+                handle = self.shim.create_container(pod)
+                self.handles[pod.name] = handle
+                self.api.set_pod_phase(pod.name, PodPhase.RUNNING,
+                                       namespace=pod.metadata.namespace)
+                started.append(handle)
+        return started
+
+    def reap(self, timeout: float | None = None) -> dict[str, int]:
+        """Wait for running containers; report exit codes and update pod
+        phases (Succeeded/Failed)."""
+        results: dict[str, int] = {}
+        for pod_name, handle in list(self.handles.items()):
+            code = handle.wait(timeout=timeout)
+            if code is None:
+                continue
+            results[pod_name] = code
+            phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
+            try:
+                self.api.set_pod_phase(pod_name, phase,
+                                       message=handle.stderr[-2000:] if code else "",
+                                       exit_code=code)
+            except NotFound:
+                pass
+            del self.handles[pod_name]
+        return results

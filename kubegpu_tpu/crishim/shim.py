@@ -1,0 +1,57 @@
+"""The CreateContainer interposition — reference: SURVEY.md §4.3.
+
+Reference flow: kubelet → crishim → (read allocation annotation → device
+manager → env/devices/mounts → rewrite ContainerConfig) → real runtime.
+Identical here, with the TPU env payload in place of NVIDIA's.
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.crishim.runtime import ContainerHandle, ContainerRuntime
+from kubegpu_tpu.kubemeta import FakeApiServer, Pod
+from kubegpu_tpu.kubemeta.codec import pod_allocation
+from kubegpu_tpu.tpuplugin.backend import DeviceBackend
+
+
+class CriShim:
+    def __init__(self, api: FakeApiServer, backend: DeviceBackend,
+                 node_name: str, runtime: ContainerRuntime):
+        self.api = api
+        self.backend = backend
+        self.node_name = node_name
+        self.runtime = runtime
+
+    def create_container(self, pod: Pod,
+                         container_index: int = 0) -> ContainerHandle:
+        """Rewrite the container spec with the allocation's TPU env and
+        forward to the runtime.  Pods with no allocation (0-device CPU
+        fallback, BASELINE config 1) pass through with TPU visibility
+        explicitly cleared."""
+        spec = pod.spec.containers[container_index]
+        alloc = pod_allocation(pod)
+        env = dict(spec.env)
+        if alloc is None or not alloc.chips:
+            env["TPU_VISIBLE_CHIPS"] = ""
+            env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+        else:
+            if alloc.node_name != self.node_name:
+                raise ValueError(
+                    f"pod {pod.name} allocated to {alloc.node_name}, "
+                    f"but this shim serves {self.node_name}")
+            adv = self.backend.discover()
+            by_local = {c.local_index: c for c in adv.chips}
+            chips = [by_local[c.local_index] for c in alloc.chips]
+            env.update(self.backend.allocate_env(
+                chips,
+                worker_id=alloc.worker_id,
+                num_workers=alloc.num_workers,
+                coordinator_address=alloc.coordinator_address,
+                worker_hostnames=alloc.worker_hostnames,
+            ))
+            millis = {c.millichips for c in alloc.chips}
+            if millis != {1000}:
+                # fractional co-tenancy: the workload self-limits HBM use
+                env["KUBETPU_MILLITPU"] = str(sum(c.millichips
+                                                 for c in alloc.chips))
+        return self.runtime.create_container(
+            pod.name, spec.name, spec.command, env)

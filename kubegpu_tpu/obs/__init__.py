@@ -1,0 +1,12 @@
+"""Observability — metrics registry + schedule trace.
+
+The reference had glog verbosity only (SURVEY.md §6); KubeTPU ships the
+counters/histograms the north-star metrics need (gang-schedule latency
+histogram → p50, allocation locality gauge) and a structured per-decision
+schedule trace (why each slice scored what).
+"""
+
+from kubegpu_tpu.obs.metrics import MetricsRegistry, global_registry
+from kubegpu_tpu.obs.trace import ScheduleTrace, TraceEvent
+
+__all__ = ["MetricsRegistry", "global_registry", "ScheduleTrace", "TraceEvent"]

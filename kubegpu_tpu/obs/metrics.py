@@ -1,0 +1,91 @@
+"""Counter / gauge / histogram registry with JSON export.
+
+Feeds the driver-defined metrics (BASELINE.md): ``schedule_latency_ms``
+histogram (p50 is north-star #1), ``allocation_locality`` gauge per gang,
+plus scheduler throughput counters.  Thread-safe; structured-JSON export.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from bisect import insort
+
+
+class _Histogram:
+    def __init__(self) -> None:
+        self._sorted: list[float] = []
+
+    def observe(self, v: float) -> None:
+        insort(self._sorted, v)
+
+    def percentile(self, p: float) -> float:
+        if not self._sorted:
+            return 0.0
+        k = min(len(self._sorted) - 1,
+                max(0, int(round(p / 100.0 * (len(self._sorted) - 1)))))
+        return self._sorted[k]
+
+    @property
+    def count(self) -> int:
+        return len(self._sorted)
+
+    @property
+    def mean(self) -> float:
+        return sum(self._sorted) / len(self._sorted) if self._sorted else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, _Histogram] = {}
+
+    def inc(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + delta
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._hists.setdefault(name, _Histogram()).observe(value)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def gauge(self, name: str) -> float:
+        with self._lock:
+            return self._gauges.get(name, 0.0)
+
+    def histogram(self, name: str) -> _Histogram:
+        with self._lock:
+            return self._hists.setdefault(name, _Histogram())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.snapshot()
+                               for k, h in self._hists.items()},
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+global_registry = MetricsRegistry()

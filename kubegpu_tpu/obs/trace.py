@@ -1,0 +1,45 @@
+"""Structured schedule trace: why each decision went the way it did.
+
+SURVEY.md §6 "Tracing": per-decision record of the candidates considered,
+scores, the winner, and phase timings — the debuggability layer the
+reference lacked.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field, asdict
+
+
+@dataclass
+class TraceEvent:
+    ts: float
+    kind: str                   # "schedule" | "fail" | "recover" | ...
+    gang: str = ""
+    detail: dict = field(default_factory=dict)
+
+
+class ScheduleTrace:
+    def __init__(self, capacity: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._events: list[TraceEvent] = []
+        self._capacity = capacity
+
+    def record(self, kind: str, gang: str = "", **detail) -> None:
+        with self._lock:
+            if len(self._events) >= self._capacity:
+                self._events.pop(0)
+            self._events.append(
+                TraceEvent(ts=time.time(), kind=kind, gang=gang,
+                           detail=detail))
+
+    def events(self, kind: str | None = None) -> list[TraceEvent]:
+        with self._lock:
+            return [e for e in self._events
+                    if kind is None or e.kind == kind]
+
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps([asdict(e) for e in self._events])

@@ -1,0 +1,12 @@
+"""Cluster scheduler — reference: ``device-scheduler`` (SURVEY.md §3).
+
+An extender-shaped service: ``filter`` (feasibility over candidate nodes),
+``prioritize`` (0–10 scores), and a gang-aware scheduling loop that holds a
+gang's pods until the whole gang fits a contiguous slice, then atomically
+commits, writes allocation annotations, and binds (SURVEY.md §4.2).  All
+cluster state is rebuilt from annotations on restart (§4.4 subtlety).
+"""
+
+from kubegpu_tpu.scheduler.extender import DeviceScheduler, ScheduleResult
+
+__all__ = ["DeviceScheduler", "ScheduleResult"]

@@ -1,0 +1,353 @@
+"""The device scheduler: extender verbs + gang queue + annotation truth.
+
+Reference call stack parity (SURVEY.md §4.2):
+  kube-scheduler → /filter → /prioritize → bind
+  device-scheduler: fill AllocateFrom, TakePodResources, PATCH annotations
+Here the same phases run in-process: ``run_once()`` plays the vanilla
+scheduler picking pods off the queue; filter/prioritize/allocate are the
+extender webhook verbs (exposed for API parity and used internally); the
+allocation annotation write-back + bind complete the path.
+
+Gang atomicity (SURVEY.md §8 hard part): the extender pattern sees one pod
+at a time, so gang state lives here — pods of a gang are *held* (never
+partially placed) until every member has arrived and a whole-gang
+assignment exists; then all members are committed/bound in one step.
+No partial placement ⇒ no gang-vs-gang deadlock; FIFO with skip ⇒ no
+head-of-line blocking.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from kubegpu_tpu.allocator import GangAllocator, GangRequest, SliceState
+from kubegpu_tpu.allocator.gang import GangAssignment, PodAssignment
+from kubegpu_tpu.kubemeta import (
+    FakeApiServer,
+    Pod,
+    PodPhase,
+    pod_allocation,
+    pod_gang_spec,
+    pod_mesh_axes,
+)
+from kubegpu_tpu.kubemeta.codec import (
+    ALLOCATE_FROM_KEY,
+    allocation_to_annotation,
+    node_advertisement,
+)
+from kubegpu_tpu.kubemeta.objects import GangSpec
+from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
+from kubegpu_tpu.tpuplugin.backend import NodeAdvertisement
+
+
+@dataclass
+class ScheduleResult:
+    scheduled: list[str] = field(default_factory=list)   # pod names bound
+    held: list[str] = field(default_factory=list)        # gang-waiting pods
+    unschedulable: list[str] = field(default_factory=list)
+
+
+@dataclass
+class _PendingGang:
+    spec: GangSpec
+    pods: dict[int, Pod] = field(default_factory=dict)   # index → pod
+    first_seen: float = field(default_factory=time.monotonic)
+
+    def complete(self) -> bool:
+        return len(self.pods) == self.spec.size
+
+
+class DeviceScheduler:
+    def __init__(self, api: FakeApiServer,
+                 allocator: GangAllocator | None = None,
+                 metrics: MetricsRegistry | None = None,
+                 trace: ScheduleTrace | None = None,
+                 coordinator_port: int = 8476):
+        self.api = api
+        self.allocator = allocator or GangAllocator()
+        self.metrics = metrics or MetricsRegistry()
+        self.trace = trace or ScheduleTrace()
+        self.coordinator_port = coordinator_port
+        self.slices: dict[str, SliceState] = {}
+        self._committed: dict[str, GangAssignment] = {}  # gang → assignment
+        self._pod_gang: dict[str, str] = {}              # pod name → gang
+        self.sync()
+
+    # ------------------------------------------------------------------
+    # Cluster-state cache (annotation truth)
+    # ------------------------------------------------------------------
+
+    def sync(self) -> None:
+        """Rebuild slice states from Node advertisements and re-apply every
+        live pod's allocation — the restart-recovery path (SURVEY.md §4.4:
+        annotations, not memory, are the source of truth)."""
+        advs: dict[str, list[NodeAdvertisement]] = {}
+        for node in self.api.list("Node"):
+            if not node.status.ready:
+                continue
+            adv = node_advertisement(node)
+            if adv is not None:
+                advs.setdefault(adv.slice_id, []).append(adv)
+        self.slices = {
+            sid: SliceState.from_advertisements(a) for sid, a in advs.items()
+        }
+        self._committed.clear()
+        self._pod_gang.clear()
+        gang_pods: dict[str, list] = {}
+        for pod in self.api.list("Pod"):
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            alloc = pod_allocation(pod)
+            if alloc is None or alloc.slice_id not in self.slices:
+                continue
+            self.slices[alloc.slice_id].take(alloc.chips)
+            gang = alloc.gang_name or pod.name
+            self._pod_gang[pod.name] = gang
+            gang_pods.setdefault(gang, []).append(alloc)
+        # Rebuild committed assignments from annotation truth so later
+        # completions release chips even across scheduler restarts/re-syncs.
+        for gang, allocs in gang_pods.items():
+            st = self.slices[allocs[0].slice_id]
+            pods = [
+                PodAssignment(
+                    pod_index=a.worker_id,
+                    node_name=a.node_name,
+                    host_id=st.topo.chip_at(a.chips[0].coord).host_id
+                    if a.chips else 0,
+                    chips=list(a.chips))
+                for a in sorted(allocs, key=lambda a: a.worker_id)
+            ]
+            self._committed[gang] = GangAssignment(
+                slice_id=allocs[0].slice_id, pods=pods,
+                locality=0.0, score=0.0)
+        self.trace.record("recover", detail={
+            "slices": len(self.slices),
+            "pods_with_allocations": len(self._pod_gang)})
+
+    def observe_node_change(self) -> None:
+        """Cheap re-sync on node add/remove/health events."""
+        self.sync()
+
+    # ------------------------------------------------------------------
+    # Extender verbs (webhook API parity — SURVEY.md §3 extender service)
+    # ------------------------------------------------------------------
+
+    def filter(self, pod: Pod, node_names: list[str]) -> tuple[list[str], dict[str, str]]:
+        """Predicate: which candidate nodes could host this pod (as a
+        1-pod gang)?  Feasibility is judged against each node's *own*
+        chips (a restricted slice view), matching the extender contract
+        that /filter answers per-node."""
+        try:
+            req = self._request_for_single(pod)
+        except ValueError as e:
+            return [], {n: f"invalid request: {e}" for n in node_names}
+        feasible: list[str] = []
+        reasons: dict[str, str] = {}
+        for name in node_names:
+            st = self._slice_of_node(name)
+            if req.total_chips == 0 and req.millitpu_per_pod == 0:
+                feasible.append(name)
+                continue
+            if st is None:
+                reasons[name] = "node has no TPU advertisement"
+                continue
+            asg = self.allocator.find_assignment(
+                [st.restricted_to_node(name)], req)
+            if asg is not None:
+                feasible.append(name)
+            else:
+                reasons[name] = "insufficient free contiguous chips on node"
+        return feasible, reasons
+
+    def prioritize(self, pod: Pod, node_names: list[str]) -> dict[str, float]:
+        """0–10 score per node (extender /prioritize), judged against the
+        node's own chips."""
+        try:
+            req = self._request_for_single(pod)
+        except ValueError:
+            return {n: 0.0 for n in node_names}
+        scores: dict[str, float] = {}
+        for name in node_names:
+            st = self._slice_of_node(name)
+            if st is None or (req.total_chips == 0
+                              and req.millitpu_per_pod == 0):
+                scores[name] = 5.0 if st is None else 0.0
+                continue
+            asg = self.allocator.find_assignment(
+                [st.restricted_to_node(name)], req)
+            scores[name] = asg.score if asg is not None else 0.0
+        return scores
+
+    # ------------------------------------------------------------------
+    # Scheduling loop
+    # ------------------------------------------------------------------
+
+    def run_once(self) -> ScheduleResult:
+        """One pass over pending pods: group into gangs, place complete
+        gangs atomically, write allocation annotations, bind."""
+        result = ScheduleResult()
+        pending = [p for p in self.api.list("Pod")
+                   if p.status.phase == PodPhase.PENDING
+                   and p.spec.node_name is None]
+        pending.sort(key=lambda p: p.metadata.resource_version)  # FIFO
+        gangs: dict[str, _PendingGang] = {}
+        singles: list[Pod] = []
+        for pod in pending:
+            gspec = pod_gang_spec(pod)
+            if gspec is None:
+                singles.append(pod)
+            else:
+                pg = gangs.setdefault(gspec.name, _PendingGang(spec=gspec))
+                pg.pods[gspec.index] = pod
+
+        for pod in singles:
+            try:
+                req = self._request_for_single(pod)
+            except ValueError as e:
+                self._reject(pod.name, [pod], str(e), result)
+                continue
+            self._schedule_gang(pod.name, [pod], req, result)
+
+        for gname, pg in gangs.items():
+            if not pg.complete():
+                result.held.extend(p.name for p in pg.pods.values())
+                self.trace.record("hold", gang=gname, detail={
+                    "have": len(pg.pods), "want": pg.spec.size})
+                continue
+            members = [pg.pods[i] for i in range(pg.spec.size)]
+            try:
+                req = self._request_for_gang(gname, members)
+            except ValueError as e:
+                self._reject(gname, members, str(e), result)
+                continue
+            self._schedule_gang(gname, members, req, result)
+        return result
+
+    def _reject(self, gang: str, members: list[Pod], reason: str,
+                result: ScheduleResult) -> None:
+        """Malformed requests must not abort the scheduling pass
+        (one bad pod cannot starve the queue)."""
+        result.unschedulable.extend(p.name for p in members)
+        self.metrics.inc("schedule_invalid")
+        self.trace.record("invalid", gang=gang, detail={"reason": reason})
+
+    def _schedule_gang(self, gang_name: str, members: list[Pod],
+                       req: GangRequest, result: ScheduleResult) -> None:
+        t0 = time.perf_counter()
+        # 0-device pods (CPU fallback, BASELINE config 1): bind to any
+        # ready node, TPU-bearing or not.
+        if req.total_chips == 0 and req.millitpu_per_pod == 0:
+            nodes = [n for n in self.api.list("Node") if n.status.ready]
+            if not nodes:
+                result.unschedulable.extend(p.name for p in members)
+                return
+            target = min(nodes, key=lambda n: n.name)
+            for pod in members:
+                self.api.bind_pod(pod.name, target.name,
+                                  namespace=pod.metadata.namespace)
+                result.scheduled.append(pod.name)
+            self._observe_latency(t0, gang_name, scheduled=True)
+            return
+
+        asg = self.allocator.find_assignment(list(self.slices.values()), req)
+        if asg is None:
+            result.unschedulable.extend(p.name for p in members)
+            self.metrics.inc("schedule_unschedulable")
+            self.trace.record("fail", gang=gang_name, detail={
+                "pods": len(members), "chips": req.total_chips,
+                "millitpu": req.millitpu_per_pod})
+            return
+
+        coordinator, hostnames = GangAllocator.coordinator_for(
+            asg, self.slices, port=self.coordinator_port)
+        allocations = asg.to_allocations(coordinator, hostnames)
+        self.allocator.commit(self.slices, asg)
+        self._committed[gang_name] = asg
+        for pod, alloc in zip(members, allocations):
+            alloc.gang_name = gang_name
+            self._pod_gang[pod.name] = gang_name
+            self.api.patch_annotations(
+                "Pod", pod.name,
+                {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc)},
+                namespace=pod.metadata.namespace)
+            self.api.bind_pod(pod.name, alloc.node_name,
+                              namespace=pod.metadata.namespace)
+            result.scheduled.append(pod.name)
+        self.metrics.set_gauge("last_allocation_locality", asg.locality)
+        self.metrics.observe("allocation_locality", asg.locality)
+        self._observe_latency(t0, gang_name, scheduled=True)
+        self.trace.record("schedule", gang=gang_name, detail={
+            "slice": asg.slice_id, "locality": asg.locality,
+            "score": asg.score,
+            "nodes": sorted({p.node_name for p in asg.pods})})
+
+    def _observe_latency(self, t0: float, gang: str, scheduled: bool) -> None:
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.observe("schedule_latency_ms", ms)
+        self.metrics.inc("gangs_scheduled" if scheduled else "gangs_failed")
+
+    # ------------------------------------------------------------------
+    # Pod lifecycle: return resources on completion/deletion (§4.4)
+    # ------------------------------------------------------------------
+
+    def return_pod_resources(self, pod_name: str) -> None:
+        gang = self._pod_gang.pop(pod_name, None)
+        if gang is None:
+            return
+        # release only when the last member of the gang is gone
+        if any(g == gang for g in self._pod_gang.values()):
+            return
+        asg = self._committed.pop(gang, None)
+        if asg is not None and asg.slice_id in self.slices:
+            self.allocator.rollback(self.slices, asg)
+            self.trace.record("release", gang=gang,
+                              detail={"slice": asg.slice_id})
+
+    # ------------------------------------------------------------------
+    # Request construction
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _sane_axes(axes: dict[str, int] | None,
+                   total_chips: int) -> dict[str, int] | None:
+        """Drop a mesh-axes hint whose product doesn't match the chip ask
+        (hints degrade gracefully; they never make a pod unschedulable)."""
+        if not axes or total_chips <= 0:
+            return None
+        prod = 1
+        for v in axes.values():
+            prod *= v
+        return axes if prod == total_chips else None
+
+    def _request_for_single(self, pod: Pod) -> GangRequest:
+        chips = pod.spec.total_chips
+        return GangRequest(
+            gang_name=pod.name,
+            num_pods=1,
+            chips_per_pod=chips,
+            millitpu_per_pod=pod.spec.total_millitpu,
+            mesh_axes=self._sane_axes(pod_mesh_axes(pod), chips),
+        )
+
+    def _request_for_gang(self, gang_name: str,
+                          members: list[Pod]) -> GangRequest:
+        per_pod = {p.spec.total_chips for p in members}
+        milli = {p.spec.total_millitpu for p in members}
+        if len(per_pod) != 1 or len(milli) != 1:
+            raise ValueError(f"gang {gang_name}: heterogeneous asks")
+        chips = per_pod.pop()
+        return GangRequest(
+            gang_name=gang_name,
+            num_pods=len(members),
+            chips_per_pod=chips,
+            millitpu_per_pod=milli.pop(),
+            mesh_axes=self._sane_axes(pod_mesh_axes(members[0]),
+                                      len(members) * chips),
+        )
+
+    def _slice_of_node(self, node_name: str) -> SliceState | None:
+        for st in self.slices.values():
+            if node_name in st.node_of_host.values():
+                return st
+        return None

@@ -31,8 +31,9 @@ class Placement:
 
     @property
     def num_chips(self) -> int:
-        a, b, c = self.shape
-        return a * b * c
+        # len(coords), not the shape product: connected-set (non-
+        # rectangular) placements carry a degenerate shape.
+        return len(self.coords)
 
 
 def subslice_shapes(n: int, mesh_shape: Coord) -> list[Coord]:

@@ -1,0 +1,6 @@
+"""Example workloads — reference: ``example/`` pod specs (SURVEY.md §3).
+
+``programs/`` are real JAX programs launched by the (simulated) runtime
+with the injected TPU env; ``specs.py`` builds the five BASELINE.json
+acceptance-config pod/gang specs that exercise the full stack.
+"""

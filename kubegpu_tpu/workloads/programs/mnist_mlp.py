@@ -1,0 +1,78 @@
+"""Single-pod MNIST-style MLP — BASELINE configs 1 & 2 workload.
+
+Run by the (simulated) container runtime with the injected env.  Verifies
+the injection contract (asserts the env the crishim set), then trains a
+small MLP on synthetic data with pure JAX — the "training framework reads
+injected env" leg of SURVEY.md §4.5.
+
+Exit 0 on success; any assertion/loss failure exits non-zero (the node
+agent maps that to pod Failed).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    expect_chips = os.environ.get("KUBETPU_EXPECT_CHIPS")
+    visible = os.environ.get("TPU_VISIBLE_CHIPS", "")
+    if expect_chips is not None:
+        got = [c for c in visible.split(",") if c != ""]
+        if len(got) != int(expect_chips):
+            print(f"FAIL: expected {expect_chips} visible chips, "
+                  f"got {visible!r}", file=sys.stderr)
+            return 2
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (256, 784))
+    y = jax.random.randint(k2, (256,), 0, 10)
+
+    def init(k):
+        k_a, k_b = jax.random.split(k)
+        return {
+            "w1": jax.random.normal(k_a, (784, 128)) * 0.05,
+            "b1": jnp.zeros((128,)),
+            "w2": jax.random.normal(k_b, (128, 10)) * 0.05,
+            "b2": jnp.zeros((10,)),
+        }
+
+    def loss_fn(params, xb, yb):
+        h = jax.nn.relu(xb @ params["w1"] + params["b1"])
+        logits = h @ params["w2"] + params["b2"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+
+    params = init(k3)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    first = None
+    for i in range(20):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        if first is None:
+            first = float(loss)
+    last = float(loss)
+    print(f"mnist_mlp: first_loss={first:.4f} last_loss={last:.4f} "
+          f"devices={jax.device_count()} worker_id="
+          f"{os.environ.get('TPU_WORKER_ID', 'unset')}")
+    if not last < first:
+        print("FAIL: loss did not decrease", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""End-to-end slice (SURVEY.md §8): mock node → advertise → schedule →
+annotation → crishim injection → subprocess runs a real JAX program that
+asserts its injected env and trains.  The full §4.5 system traversal."""
+
+import pytest
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, PodPhase
+from kubegpu_tpu.kubemeta.codec import pod_allocation
+
+MNIST = ["python", "-m", "kubegpu_tpu.workloads.programs.mnist_mlp"]
+
+
+class TestFakeRuntimePath:
+    """Scheduling + injection correctness without real processes."""
+
+    def test_single_chip_pod_full_path(self):
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("resnet", chips=1, command=["noop"]))
+        result, started = cl.step()
+        assert result.scheduled == ["resnet"]
+        assert len(started) == 1
+        env = started[0].env
+        assert len(env["TPU_VISIBLE_CHIPS"].split(",")) == 1
+        assert env["TPU_WORKER_ID"] == "0"
+        assert env["JAX_NUM_PROCESSES"] == "1"
+        alloc = pod_allocation(cl.api.get("Pod", "resnet"))
+        assert alloc is not None
+        assert len(alloc.chips) == 1
+
+    def test_zero_device_pod_cpu_fallback(self):
+        """BASELINE config 1: 0-device request binds with no allocation."""
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("mnist-cpu", chips=0, command=["noop"]))
+        result, started = cl.step()
+        assert result.scheduled == ["mnist-cpu"]
+        env = started[0].env
+        assert env["TPU_VISIBLE_CHIPS"] == ""
+        assert pod_allocation(cl.api.get("Pod", "mnist-cpu")) is None
+
+    def test_gang_waits_for_all_members(self):
+        cl = SimCluster(["v4-8"])
+        g = lambda i: GangSpec(name="dpjob", size=4, index=i)
+        cl.submit(tpu_pod("dp-0", chips=1, gang=g(0), command=["noop"]))
+        cl.submit(tpu_pod("dp-1", chips=1, gang=g(1), command=["noop"]))
+        result, started = cl.step()
+        assert result.scheduled == []
+        assert set(result.held) == {"dp-0", "dp-1"}
+        assert started == []
+        # remaining members arrive → whole gang goes at once
+        cl.submit(tpu_pod("dp-2", chips=1, gang=g(2), command=["noop"]))
+        cl.submit(tpu_pod("dp-3", chips=1, gang=g(3), command=["noop"]))
+        result, started = cl.step()
+        assert len(result.scheduled) == 4
+        assert len(started) == 4
+        # worker ids follow gang indices; all share one coordinator
+        envs = {h.pod_name: h.env for h in started}
+        assert [envs[f"dp-{i}"]["TPU_WORKER_ID"] for i in range(4)] == \
+            ["0", "1", "2", "3"]
+        assert len({e["JAX_COORDINATOR_ADDRESS"]
+                    for e in envs.values()}) == 1
+        # 4 distinct chips on the single v4-8 host
+        chips = {e["TPU_VISIBLE_CHIPS"] for e in envs.values()}
+        assert len(chips) == 4
+
+    def test_multihost_gang_spans_hosts(self):
+        """BASELINE config 4 shape: 4 pods x 4 chips over v5e-16."""
+        cl = SimCluster(["v5e-16"])
+        for i in range(4):
+            cl.submit(tpu_pod(f"llama-{i}", chips=4,
+                              gang=GangSpec(name="llama", size=4, index=i),
+                              mesh_axes={"dp": 4, "tp": 4},
+                              command=["noop"]))
+        result, started = cl.step()
+        assert len(result.scheduled) == 4
+        nodes = {cl.api.get("Pod", f"llama-{i}").spec.node_name
+                 for i in range(4)}
+        assert len(nodes) == 4  # one pod per host
+        hostnames = {h.env["TPU_WORKER_HOSTNAMES"] for h in started}
+        assert len(hostnames) == 1  # all agree on the roster
+
+    def test_multitenant_fractional_plus_gang(self):
+        """BASELINE config 5: fractional pods co-tenant with a slice job."""
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("frac-a", millitpu=300, command=["noop"]))
+        cl.submit(tpu_pod("frac-b", millitpu=600, command=["noop"]))
+        for i in range(3):
+            cl.submit(tpu_pod(f"gang-{i}", chips=1,
+                              gang=GangSpec(name="g3", size=3, index=i),
+                              command=["noop"]))
+        result, _ = cl.step()
+        assert len(result.scheduled) == 5
+        # fractional pods share one chip; gang gets 3 whole other chips
+        fa = pod_allocation(cl.api.get("Pod", "frac-a")).chips[0]
+        fb = pod_allocation(cl.api.get("Pod", "frac-b")).chips[0]
+        assert fa.coord == fb.coord
+        gang_coords = {pod_allocation(cl.api.get("Pod", f"gang-{i}")
+                                      ).chips[0].coord for i in range(3)}
+        assert fa.coord not in gang_coords
+        assert len(gang_coords) == 3
+
+    def test_resources_returned_on_completion(self):
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("a", chips=4, command=["noop"]))
+        cl.step()
+        st = next(iter(cl.scheduler.slices.values()))
+        assert sum(st.used_millichips.values()) == 4000
+        cl.reap()  # FakeRuntime exits 0 instantly → Succeeded → release
+        assert cl.pod_phase("a") == PodPhase.SUCCEEDED
+        assert sum(st.used_millichips.values()) == 0
+        # slice reusable
+        cl.submit(tpu_pod("b", chips=4, command=["noop"]))
+        result, _ = cl.step()
+        assert result.scheduled == ["b"]
+
+    def test_scheduler_restart_recovers_from_annotations(self):
+        """SURVEY.md §4.4: rebuild Used purely from pod annotations."""
+        from kubegpu_tpu.scheduler import DeviceScheduler
+        cl = SimCluster(["v5e-16"])
+        cl.submit(tpu_pod("a", chips=4, command=["noop"]))
+        cl.submit(tpu_pod("b", chips=2, command=["noop"]))
+        cl.step()
+        old_used = {
+            sid: dict(st.used_millichips)
+            for sid, st in cl.scheduler.slices.items()}
+        fresh = DeviceScheduler(cl.api)  # brand-new process, same apiserver
+        new_used = {
+            sid: {k: v for k, v in st.used_millichips.items() if v}
+            for sid, st in fresh.slices.items()}
+        old_used = {
+            sid: {k: v for k, v in used.items() if v}
+            for sid, used in old_used.items()}
+        assert new_used == old_used
+
+    def test_unschedulable_oversize(self):
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("big", chips=8, command=["noop"]))
+        result, _ = cl.step()
+        assert result.scheduled == []
+        assert result.unschedulable == ["big"]
+
+    def test_schedule_latency_metric_populated(self):
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("a", chips=1, command=["noop"]))
+        cl.step()
+        snap = cl.metrics.snapshot()
+        assert snap["histograms"]["schedule_latency_ms"]["count"] == 1
+        assert cl.trace.events("schedule")
+
+
+@pytest.mark.slow
+class TestRealProcessPath:
+    """The full traversal with real subprocesses running real JAX on CPU."""
+
+    def test_mnist_single_pod_trains(self):
+        cl = SimCluster(["v4-8"], real_processes=True,
+                        extra_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            cl.submit(tpu_pod("mnist", chips=1, command=MNIST,
+                              env={"KUBETPU_EXPECT_CHIPS": "1"}))
+            codes = cl.run_to_completion(timeout_s=120)
+            assert codes.get("mnist") == 0, \
+                cl.api.get("Pod", "mnist").status.message
+            assert cl.pod_phase("mnist") == PodPhase.SUCCEEDED
+        finally:
+            cl.close()
+
+    def test_mnist_zero_device_cpu_fallback(self):
+        """BASELINE config 1 end-to-end: CPU-only pod runs the trainer."""
+        cl = SimCluster(["v4-8"], real_processes=True)
+        try:
+            cl.submit(tpu_pod("mnist-cpu", chips=0, command=MNIST,
+                              env={"KUBETPU_EXPECT_CHIPS": "0"}))
+            codes = cl.run_to_completion(timeout_s=120)
+            assert codes.get("mnist-cpu") == 0
+        finally:
+            cl.close()

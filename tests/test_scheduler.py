@@ -1,0 +1,133 @@
+"""Scheduler-specific tests: extender verbs, resilience, recovery.
+
+Several cases here are regressions from the round-1 code review:
+re-sync must not orphan committed gangs; malformed pods must not abort
+the scheduling pass; fractional gang pods must not silently become CPU
+pods; filter() must answer per-node.
+"""
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, PodPhase
+from kubegpu_tpu.kubemeta.codec import pod_allocation
+from kubegpu_tpu.scheduler import DeviceScheduler
+
+
+class TestExtenderVerbs:
+    def test_filter_per_node_feasibility(self):
+        """Every host with enough free chips is feasible — not just the
+        argmax host (review finding #4)."""
+        cl = SimCluster(["v5e-16"])
+        pod = tpu_pod("p", chips=4, command=["x"])
+        cl.api.create("Pod", pod)
+        nodes = [n.name for n in cl.api.list("Node")]
+        feasible, reasons = cl.scheduler.filter(pod, nodes)
+        assert set(feasible) == set(nodes), reasons
+
+    def test_filter_rejects_busy_node(self):
+        cl = SimCluster(["v5e-16"])
+        # fill host 0's block via a 4-chip pod pinned by scheduling
+        cl.submit(tpu_pod("warm", chips=4, command=["x"]))
+        cl.step()
+        warm_node = cl.api.get("Pod", "warm").spec.node_name
+        pod = tpu_pod("p", chips=4, command=["x"])
+        cl.api.create("Pod", pod)
+        feasible, reasons = cl.scheduler.filter(
+            pod, [n.name for n in cl.api.list("Node")])
+        assert warm_node not in feasible
+        assert len(feasible) == 3
+
+    def test_prioritize_scores_per_node(self):
+        cl = SimCluster(["v5e-16"])
+        pod = tpu_pod("p", chips=1, command=["x"])
+        cl.api.create("Pod", pod)
+        scores = cl.scheduler.prioritize(
+            pod, [n.name for n in cl.api.list("Node")])
+        assert all(0.0 <= s <= 10.0 for s in scores.values())
+        assert any(s > 0 for s in scores.values())
+
+    def test_filter_zero_device_pod_fits_everywhere(self):
+        cl = SimCluster(["v4-8"])
+        pod = tpu_pod("p", chips=0, command=["x"])
+        cl.api.create("Pod", pod)
+        feasible, _ = cl.scheduler.filter(
+            pod, [n.name for n in cl.api.list("Node")])
+        assert feasible
+
+
+class TestResilience:
+    def test_bad_mesh_axes_does_not_abort_pass(self):
+        """Review finding #2: one malformed pod must not starve the rest.
+        A mismatched mesh-axes hint is dropped, not fatal."""
+        cl = SimCluster(["v4-8"])
+        bad = tpu_pod("bad", chips=2, mesh_axes={"dp": 3, "tp": 5},
+                      command=["x"])
+        good = tpu_pod("good", chips=1, command=["x"])
+        cl.submit(bad, good)
+        result, _ = cl.step()
+        assert "good" in result.scheduled
+        assert "bad" in result.scheduled  # hint dropped, pod still placed
+
+    def test_fractional_gang_pod_gets_allocation(self):
+        """Review finding #3: a gang-annotated fractional pod must be a
+        fractional allocation, not a silent CPU fallback."""
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("f0", millitpu=500,
+                          gang=GangSpec(name="fg", size=1, index=0),
+                          command=["x"]))
+        result, _ = cl.step()
+        assert result.scheduled == ["f0"]
+        alloc = pod_allocation(cl.api.get("Pod", "f0"))
+        assert alloc is not None
+        assert alloc.chips[0].millichips == 500
+
+    def test_heterogeneous_gang_rejected_not_fatal(self):
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("h0", chips=1,
+                          gang=GangSpec(name="het", size=2, index=0),
+                          command=["x"]))
+        cl.submit(tpu_pod("h1", chips=2,
+                          gang=GangSpec(name="het", size=2, index=1),
+                          command=["x"]))
+        cl.submit(tpu_pod("ok", chips=1, command=["x"]))
+        result, _ = cl.step()
+        assert "ok" in result.scheduled
+        assert {"h0", "h1"} <= set(result.unschedulable)
+
+    def test_resync_preserves_release_path(self):
+        """Review finding #1 (critical): after observe_node_change(), a
+        completing pod must still release its chips."""
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("a", chips=4, command=["x"]))
+        cl.step()
+        cl.scheduler.observe_node_change()  # re-sync wipes in-memory state
+        st = next(iter(cl.scheduler.slices.values()))
+        assert sum(st.used_millichips.values()) == 4000  # still accounted
+        cl.reap()  # FakeRuntime → Succeeded → release
+        st = next(iter(cl.scheduler.slices.values()))
+        assert sum(st.used_millichips.values()) == 0
+        cl.submit(tpu_pod("b", chips=4, command=["x"]))
+        result, _ = cl.step()
+        assert result.scheduled == ["b"]
+
+    def test_restarted_scheduler_releases_on_completion(self):
+        """Full restart: a fresh DeviceScheduler must release a gang it
+        never scheduled itself (annotation truth only)."""
+        cl = SimCluster(["v5e-16"])
+        for i in range(2):
+            cl.submit(tpu_pod(f"g-{i}", chips=4,
+                              gang=GangSpec(name="g", size=2, index=i),
+                              command=["x"]))
+        cl.step()
+        fresh = DeviceScheduler(cl.api)
+        used = sum(sum(st.used_millichips.values())
+                   for st in fresh.slices.values())
+        assert used == 8000
+        fresh.return_pod_resources("g-0")
+        # gang partially alive → not yet released
+        used = sum(sum(st.used_millichips.values())
+                   for st in fresh.slices.values())
+        assert used == 8000
+        fresh.return_pod_resources("g-1")
+        used = sum(sum(st.used_millichips.values())
+                   for st in fresh.slices.values())
+        assert used == 0
