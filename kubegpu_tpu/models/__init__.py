@@ -1,0 +1,12 @@
+"""Model families for the workload layer (reference: example/ specs'
+training programs).  Llama (pure JAX, pjit/GSPMD-sharded, the flagship),
+ResNet-50 (flax), and the MNIST MLP (inside workloads/programs)."""
+
+from kubegpu_tpu.models.llama import (
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_param_specs,
+)
+
+__all__ = ["LlamaConfig", "llama_forward", "llama_init", "llama_param_specs"]

@@ -1,0 +1,243 @@
+"""Llama-3-family decoder in pure JAX — the flagship pjit workload
+(BASELINE config 4: Llama-3-8B on v5e-16/64).
+
+TPU-first design choices:
+- layers stored *stacked* (leading n_layers dim) and executed with
+  ``lax.scan`` — one traced layer, O(1) compile time at any depth;
+- bfloat16 params/activations, f32 for norms/softmax/logits;
+- megatron-style sharding rules as a PartitionSpec tree (dp/fsdp batch,
+  tp on head/ffn dims), applied by jit shardings + in-graph constraints;
+- attention dispatches to the pallas flash kernel on TPU;
+- optional ``jax.checkpoint`` per layer (remat) for long sequences;
+- optional ring attention over the ``sp`` axis for sequence parallelism.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubegpu_tpu.ops import attention
+from kubegpu_tpu.parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True
+    attn_impl: str = "auto"   # auto | pallas | xla | ring
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test-scale config with the same structure."""
+        base = cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, d_ff=128, max_seq_len=128,
+                   dtype="float32", remat=False, attn_impl="xla")
+        return replace(base, **kw)
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def llama_init(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Stacked-layer parameter pytree."""
+    hd = cfg.head_dim
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+
+    def norm_init(shape):
+        return jnp.ones(shape, cfg.jdtype)
+
+    def dense_init(k, shape, scale_dim):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (scale_dim ** -0.5)).astype(cfg.jdtype)
+
+    ks = jax.random.split(k_layers, 7)
+    L = cfg.n_layers
+    layers = {
+        "attn_norm": norm_init((L, cfg.d_model)),
+        "wq": dense_init(ks[0], (L, cfg.d_model, cfg.n_heads * hd),
+                         cfg.d_model),
+        "wk": dense_init(ks[1], (L, cfg.d_model, cfg.n_kv_heads * hd),
+                         cfg.d_model),
+        "wv": dense_init(ks[2], (L, cfg.d_model, cfg.n_kv_heads * hd),
+                         cfg.d_model),
+        "wo": dense_init(ks[3], (L, cfg.n_heads * hd, cfg.d_model),
+                         cfg.n_heads * hd),
+        "mlp_norm": norm_init((L, cfg.d_model)),
+        "w_gate": dense_init(ks[4], (L, cfg.d_model, cfg.d_ff), cfg.d_model),
+        "w_up": dense_init(ks[5], (L, cfg.d_model, cfg.d_ff), cfg.d_model),
+        "w_down": dense_init(ks[6], (L, cfg.d_ff, cfg.d_model), cfg.d_ff),
+    }
+    return {
+        "embed": dense_init(k_emb, (cfg.vocab_size, cfg.d_model), cfg.d_model),
+        "layers": layers,
+        "final_norm": norm_init((cfg.d_model,)),
+        "lm_head": dense_init(k_out, (cfg.d_model, cfg.vocab_size),
+                              cfg.d_model),
+    }
+
+
+def llama_param_specs(cfg: LlamaConfig) -> dict:
+    """Megatron/GSPMD sharding rules (PartitionSpec tree, stacked-layer
+    leading dim unsharded; ``fsdp`` shards the non-tp dim; norms are
+    replicated).  Axes absent from the actual mesh are dropped by
+    ``fit_spec`` at materialization."""
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms).astype(x.dtype) * w
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, T, H, D] — rotate pairs (d, d + D/2)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None, None].astype(jnp.float32) \
+        * freqs[None, None, None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def llama_forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                  mesh: Mesh | None = None) -> jax.Array:
+    """tokens [B, T] → logits [B, T, vocab] (f32).
+
+    Batch is sharded on (dp, fsdp); hidden activations are constrained to
+    tp on the head/ffn dim so XLA places the megatron allreduces; with
+    ``attn_impl='ring'`` the sequence axis is sharded on sp and attention
+    runs as a ppermute ring.
+    """
+    b, t = tokens.shape
+    hd = cfg.head_dim
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = constrain(x, mesh, ("dp", "fsdp"), "sp", None)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    if cfg.attn_impl == "ring" and mesh is not None \
+            and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        from kubegpu_tpu.parallel.ringattention import (
+            make_sharded_ring_attention,
+        )
+        attend = _gqa_wrap(make_sharded_ring_attention(mesh), cfg)
+    else:
+        attend = lambda q, k, v: attention(q, k, v, causal=True,
+                                           impl=_attn_impl(cfg))
+
+    def layer(x, lp):
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        # [B, H, T, D] for the attention kernels
+        o = attend(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                   v.transpose(0, 2, 1, 3))
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
+        o = constrain(o, mesh, ("dp", "fsdp"), "sp", "tp")
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+        up = constrain(up, mesh, ("dp", "fsdp"), "sp", "tp")
+        x = x + (up @ lp["w_down"]).astype(x.dtype)
+        x = constrain(x, mesh, ("dp", "fsdp"), "sp", None)
+        return x, None
+
+    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return constrain(logits, mesh, ("dp", "fsdp"), "sp", "tp")
+
+
+def _attn_impl(cfg: LlamaConfig) -> str:
+    return cfg.attn_impl if cfg.attn_impl != "ring" else "auto"
+
+
+def _gqa_wrap(ring_fn, cfg: LlamaConfig):
+    """Repeat kv heads before the ring (ring_attention wants Hq == Hkv)."""
+    from kubegpu_tpu.ops.flash_attention import repeat_kv
+
+    def attend(q, k, v):
+        k, v = repeat_kv(q, k, v)
+        return ring_fn(q, k, v)
+    return attend
+
+
+# ---------------------------------------------------------------------------
+# Loss / train step builders (shared by workloads, bench, graft entry)
+# ---------------------------------------------------------------------------
+
+def next_token_loss(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                    mesh: Mesh | None = None) -> jax.Array:
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
+    logits = llama_forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def make_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh | None = None):
+    """(params, opt_state, tokens) → (params, opt_state, loss), undecorated
+    (callers jit with their shardings)."""
+    import optax
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(next_token_loss)(
+            params, tokens, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,11 @@
+"""TPU compute ops: pallas kernels with XLA fallbacks.
+
+The reference had no compute path at all (it scheduled containers); the
+workload layer here is TPU-first: the hot op (causal attention) ships as a
+pallas flash-attention kernel for the MXU, with a pure-XLA fallback used on
+CPU (tests) and as a numerics reference.
+"""
+
+from kubegpu_tpu.ops.flash_attention import attention, flash_attention, xla_attention
+
+__all__ = ["attention", "flash_attention", "xla_attention"]

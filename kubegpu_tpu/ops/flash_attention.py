@@ -1,0 +1,147 @@
+"""Flash attention: pallas TPU kernel + XLA reference.
+
+Design per /opt/skills/guides/pallas_guide.md: grid over (batch·heads,
+q-blocks); K/V live in VMEM per (b,h); online-softmax accumulation over
+k-blocks with a fori_loop; f32 accumulators (`preferred_element_type`);
+causal masking via broadcasted iotas.  Falls back to a fused-by-XLA
+einsum+softmax implementation off-TPU (and for odd shapes), so every
+caller works identically on CPU tests and TPU benches.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(q: jax.Array, k: jax.Array,
+              v: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """GQA: repeat kv heads up to the query head count (Hq % Hkv == 0)."""
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq == hkv:
+        return k, v
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    rep = hq // hkv
+    return jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1)
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True,
+                  scale: float | None = None) -> jax.Array:
+    """Reference attention.  q: [B, Hq, T, D]; k/v: [B, Hkv, S, D].
+    GQA via ``repeat_kv``.  Causal masking is *end-aligned* when t < s
+    (query i attends keys <= i + s - t, the decode/suffix convention)."""
+    b, hq, t, d = q.shape
+    _, hkv, s, _ = k.shape
+    k, v = repeat_kv(q, k, v)
+    scale = scale if scale is not None else d ** -0.5
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", probs.astype(v.dtype), v)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Pallas flash attention.  Shapes as ``xla_attention`` (GQA folded
+    by repeating kv heads before the kernel — the bandwidth win of true
+    grouped reads is a later-round optimization)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, t, d = q.shape
+    _, hkv, s, _ = k.shape
+    k, v = repeat_kv(q, k, v)
+    scale = d ** -0.5
+    causal_offset = s - t  # end-aligned, matching xla_attention
+    block_q = min(block_q, t)
+    block_k = min(block_k, s)
+    if t % block_q or s % block_k:
+        return xla_attention(q, k, v, causal=causal)
+
+    qf = q.reshape(b * hq, t, d)
+    kf = k.reshape(b * hq, s, d)
+    vf = v.reshape(b * hq, s, d)
+    num_k_blocks = s // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        qb = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+
+        def body(ki, carry):
+            o_acc, m_acc, l_acc = carry
+            kb = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            sc = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bq, bk]
+            if causal:
+                qpos = causal_offset + qi * block_q + \
+                    jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 0)
+                kpos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                sc = jnp.where(qpos >= kpos, sc, NEG_INF)
+            m_new = jnp.maximum(m_acc, sc.max(axis=-1, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            alpha = jnp.exp(m_acc - m_new)
+            l_new = alpha * l_acc + p.sum(axis=-1, keepdims=True)
+            o_new = alpha * o_acc + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return o_new, m_new, l_new
+
+        o0 = jnp.zeros((block_q, d), jnp.float32)
+        m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        if causal:
+            # k-blocks strictly past this q-block's LAST row's horizon
+            # contribute nothing; the last visible key index is
+            # offset + (qi+1)*block_q - 1.
+            horizon = causal_offset + (qi + 1) * block_q - 1
+            n_iter = jnp.minimum(num_k_blocks, horizon // block_k + 1)
+        else:
+            n_iter = num_k_blocks
+        o_acc, m_acc, l_acc = jax.lax.fori_loop(0, n_iter, body,
+                                                (o0, m0, l0))
+        o_ref[0] = (o_acc / jnp.maximum(l_acc, 1e-30)).astype(o_ref.dtype)
+
+    grid = (b * hq, t // block_q)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, hq, t, d)
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = True, impl: str = "auto") -> jax.Array:
+    """Dispatch: pallas on TPU, XLA elsewhere.  ``impl`` ∈ auto | pallas |
+    pallas_interpret | xla."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "pallas_interpret":
+        return flash_attention(q, k, v, causal=causal, interpret=True)
+    return xla_attention(q, k, v, causal=causal)

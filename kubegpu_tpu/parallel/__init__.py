@@ -1,0 +1,21 @@
+"""Parallelism layer: device meshes, sharding rules, ring attention.
+
+The reference contained no parallelism code (SURVEY.md §3: it *placed*
+workloads; NCCL ran inside user containers).  KubeTPU's workload layer is
+TPU-native: explicit ``jax.sharding.Mesh`` axes (dp/fsdp/tp/sp), GSPMD
+sharding rules for the model families, and sequence parallelism via
+shard_map + ppermute ring attention — the collectives the scheduler's
+locality model optimizes placement for.
+"""
+
+from kubegpu_tpu.parallel.mesh import MeshAxes, make_mesh, mesh_axis_sizes
+from kubegpu_tpu.parallel.ringattention import ring_attention
+from kubegpu_tpu.parallel.sharding import (
+    constrain,
+    named_sharding_tree,
+)
+
+__all__ = [
+    "MeshAxes", "make_mesh", "mesh_axis_sizes",
+    "ring_attention", "constrain", "named_sharding_tree",
+]

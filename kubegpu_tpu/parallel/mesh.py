@@ -1,0 +1,37 @@
+"""Mesh construction: logical parallel axes over physical devices.
+
+Axis vocabulary (matches the scheduler's mesh-axes annotation, so the
+locality the allocator optimized is the locality the workload uses):
+
+- ``dp``   — pure data parallelism (gradient allreduce)
+- ``fsdp`` — data parallelism with sharded params (all-gather/reduce-scatter)
+- ``tp``   — tensor (megatron) parallelism (per-layer allreduce, hottest)
+- ``sp``   — sequence/context parallelism (ring attention neighbor exchange)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+MeshAxes = ("dp", "fsdp", "tp", "sp")
+
+
+def make_mesh(axis_sizes: dict[str, int],
+              devices: list | None = None) -> Mesh:
+    """Build a Mesh with the given logical axes (ordered dict; product must
+    equal device count).  Axes of size 1 are kept so sharding rules can
+    always reference the full axis vocabulary."""
+    devs = devices if devices is not None else jax.devices()
+    n = int(np.prod(list(axis_sizes.values())))
+    if n != len(devs):
+        raise ValueError(
+            f"mesh axes {axis_sizes} product {n} != {len(devs)} devices")
+    arr = np.array(devs).reshape(*axis_sizes.values())
+    return Mesh(arr, tuple(axis_sizes.keys()))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

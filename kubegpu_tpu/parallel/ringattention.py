@@ -1,0 +1,91 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context support (SURVEY.md §6 "long-context"): Q stays put; K/V
+blocks rotate around the ``sp`` ring via ``ppermute`` (ICI neighbor
+exchange — exactly the traffic the allocator's ring-closure ordering makes
+single-hop), with flash-style online-softmax accumulation so the full
+sequence is never materialized on one chip.
+
+Used under ``shard_map`` with sequences sharded along ``sp``; degenerates
+to plain attention when the axis has size 1.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.ops.flash_attention import NEG_INF
+
+
+def _block_attend(q, k, v, q_pos, k_pos, causal, scale):
+    """One (q-block × kv-block) flash step → (o_partial, m, l).
+    q: [B,H,Tq,D], k/v: [B,H,Tk,D]; positions are global token indices."""
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    m = scores.max(axis=-1, keepdims=True)
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(scores - m_safe)
+    p = jnp.where(scores <= NEG_INF / 2, 0.0, p)
+    l = p.sum(axis=-1, keepdims=True)
+    o = jnp.einsum("bhts,bhsd->bhtd", p, v.astype(jnp.float32))
+    return o, m_safe, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = True) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call under shard_map; q/k/v are the *local* sequence blocks
+    [B, H, T_local, D] and the result is the local output block.  GQA via
+    repeated kv heads (match head counts before sharding).
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    scale = d ** -0.5
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    def step(i, carry):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        # block currently held arrived from (my_idx + i) counter-ring-wise
+        src = (my_idx - i) % axis_size
+        k_pos = src * t_local + jnp.arange(t_local)
+        o_p, m_p, l_p = _block_attend(q, k_cur, v_cur, q_pos, k_pos,
+                                      causal, scale)
+        m_new = jnp.maximum(m_acc, m_p)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_p - m_new)
+        o_new = o_acc * alpha + o_p * beta
+        l_new = l_acc * alpha + l_p * beta
+        # rotate kv to the next rank (ring neighbor exchange on ICI)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o_new, m_new, l_new, k_nxt, v_nxt
+
+    o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(
+        0, axis_size, step, (o0, m0, l0, k, v))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def make_sharded_ring_attention(mesh, axis_name: str = "sp",
+                                causal: bool = True):
+    """shard_map-wrapped ring attention: takes global [B,H,T,D] arrays
+    sharded on T and returns the same."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)

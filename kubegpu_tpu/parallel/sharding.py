@@ -1,0 +1,39 @@
+"""Sharding utilities: PartitionSpec trees → NamedShardings, activation
+constraints that degrade gracefully off-mesh."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _fix_axis(a, names: set[str]):
+    if a is None:
+        return None
+    if isinstance(a, (tuple, list)):
+        kept = tuple(x for x in a if x in names)
+        return kept if kept else None
+    return a if a in names else None
+
+
+def fit_spec(mesh: Mesh, spec: P) -> P:
+    """Drop axis names ``mesh`` doesn't have, so one rule set serves
+    dp-only and dp×fsdp×tp meshes alike."""
+    names = set(mesh.axis_names)
+    return P(*(_fix_axis(a, names) for a in spec))
+
+
+def named_sharding_tree(mesh: Mesh, spec_tree):
+    """Map a pytree of PartitionSpec to NamedSharding over ``mesh``."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, fit_spec(mesh, s)), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def constrain(x: jax.Array, mesh: Mesh | None, *spec) -> jax.Array:
+    """``with_sharding_constraint`` against ``mesh``; identity when no mesh
+    is in play (single-device tests, the driver's single-chip entry)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, fit_spec(mesh, P(*spec))))

@@ -1,17 +1,29 @@
-"""Test harness config: force an 8-device virtual CPU mesh BEFORE jax import.
+"""Test harness config: force an 8-device virtual CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; all sharding/pjit tests run
 against ``xla_force_host_platform_device_count=8`` virtual CPU devices (the
-same mechanism the driver's dryrun uses).  Must run before anything imports
-jax, hence top of conftest.
+same mechanism the driver's dryrun uses).
+
+Environment subtlety (discovered the hard way): this image preloads jax at
+interpreter start via a sitecustomize on PYTHONPATH that registers the
+``axon`` TPU-tunnel platform, so setting ``JAX_PLATFORMS`` env vars here is
+too late — ``jax.config.update`` after import is the reliable switch, and
+XLA_FLAGS still works as long as no backend has initialized yet.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# NB: subprocess workloads do NOT inherit env from here —
+# SubprocessRuntime whitelists a minimal env; tests that launch real
+# processes pass JAX_PLATFORMS via extra_env / the crishim's own injection.
+
+import jax  # noqa: E402  (possibly already preloaded by sitecustomize)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,84 @@
+"""Flash-attention kernel numerics (pallas interpret mode vs XLA ref)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubegpu_tpu.ops import flash_attention, xla_attention
+
+
+def rand_qkv(key, b=2, hq=4, hkv=4, t=128, s=128, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (b, hq, t, d), dtype),
+            jax.random.normal(kk, (b, hkv, s, d), dtype),
+            jax.random.normal(kv, (b, hkv, s, d), dtype))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_xla_reference(self, causal):
+        q, k, v = rand_qkv(jax.random.PRNGKey(0))
+        ref = xla_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=64,
+                              block_k=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gqa_heads(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(1), hq=8, hkv=2)
+        ref = xla_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=64,
+                              block_k=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_multi_kv_block_accumulation(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(2), t=128, s=256)
+        ref = xla_attention(q, k, v, causal=False)
+        out = flash_attention(q, k, v, causal=False, block_q=32,
+                              block_k=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_mismatched_block_sizes(self):
+        """Regression (review): block_q > block_k must not drop K blocks
+        near the causal diagonal."""
+        q, k, v = rand_qkv(jax.random.PRNGKey(7), t=128, s=128)
+        ref = xla_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=64,
+                              block_k=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        out2 = flash_attention(q, k, v, causal=True, block_q=32,
+                               block_k=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_causal_alignment_t_lt_s(self):
+        """Regression (review): t < s causal must be end-aligned in both
+        implementations (decode/suffix convention)."""
+        q, k, v = rand_qkv(jax.random.PRNGKey(8), t=64, s=128)
+        ref = xla_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=32,
+                              block_k=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_odd_shapes_fall_back(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(3), t=100, s=100)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_causal_masks_future(self):
+        """Changing future tokens must not change past outputs."""
+        q, k, v = rand_qkv(jax.random.PRNGKey(4), t=64, s=64)
+        out1 = xla_attention(q, k, v, causal=True)
+        k2 = k.at[:, :, 32:, :].set(0.0)
+        v2 = v.at[:, :, 32:, :].set(0.0)
+        out2 = xla_attention(q, k2, v2, causal=True)
+        np.testing.assert_allclose(np.asarray(out1[:, :, :32]),
+                                   np.asarray(out2[:, :, :32]),
+                                   atol=1e-6)
