@@ -92,12 +92,14 @@ def run_bench(n_gangs: int = 60, seed: int = 0) -> dict:
     snap = cl.metrics.snapshot()
     hist = snap["histograms"].get("schedule_latency_ms", {})
     loc = snap["histograms"].get("allocation_locality", {})
+    p50 = hist.get("p50", 0.0)
     return {
         "metric": "gang_schedule_p50_latency",
-        "value": round(hist.get("p50", 0.0), 3),
+        "value": round(p50, 3),
         "unit": "ms",
-        "vs_baseline": round(BASELINE_P50_MS / max(hist.get("p50", 1e-9),
-                                                   1e-9), 2),
+        # 0.0 (not inf) when nothing scheduled: a broken run must not
+        # read as a record win
+        "vs_baseline": round(BASELINE_P50_MS / p50, 2) if p50 > 0 else 0.0,
         "details": {
             "p90_ms": round(hist.get("p90", 0.0), 3),
             "p99_ms": round(hist.get("p99", 0.0), 3),

@@ -7,9 +7,11 @@ Identical here, with the TPU env payload in place of NVIDIA's.
 
 from __future__ import annotations
 
+import json
+
 from kubegpu_tpu.crishim.runtime import ContainerHandle, ContainerRuntime
 from kubegpu_tpu.kubemeta import FakeApiServer, Pod
-from kubegpu_tpu.kubemeta.codec import pod_allocation
+from kubegpu_tpu.kubemeta.codec import pod_allocation, pod_mesh_axes
 from kubegpu_tpu.tpuplugin.backend import DeviceBackend
 
 
@@ -53,5 +55,10 @@ class CriShim:
                 # fractional co-tenancy: the workload self-limits HBM use
                 env["KUBETPU_MILLITPU"] = str(sum(c.millichips
                                                  for c in alloc.chips))
+            axes = pod_mesh_axes(pod)
+            if axes:
+                # close the loop: the mesh the allocator optimized
+                # placement for IS the mesh the workload builds
+                env["KUBETPU_MESH_AXES"] = json.dumps(list(axes.items()))
         return self.runtime.create_container(
             pod.name, spec.name, spec.command, env)

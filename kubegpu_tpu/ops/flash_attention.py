@@ -38,6 +38,10 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (query i attends keys <= i + s - t, the decode/suffix convention)."""
     b, hq, t, d = q.shape
     _, hkv, s, _ = k.shape
+    if causal and t > s:
+        raise ValueError(
+            f"causal attention with more queries ({t}) than keys ({s}) is "
+            "ill-defined (queries before the key horizon attend nothing)")
     k, v = repeat_kv(q, k, v)
     scale = scale if scale is not None else d ** -0.5
     scores = jnp.einsum("bhtd,bhsd->bhts", q, k,
@@ -63,6 +67,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     b, hq, t, d = q.shape
     _, hkv, s, _ = k.shape
+    if causal and t > s:
+        raise ValueError(
+            f"causal attention with more queries ({t}) than keys ({s}) is "
+            "ill-defined (queries before the key horizon attend nothing)")
     k, v = repeat_kv(q, k, v)
     scale = d ** -0.5
     causal_offset = s - t  # end-aligned, matching xla_attention

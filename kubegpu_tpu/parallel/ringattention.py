@@ -84,7 +84,12 @@ def make_sharded_ring_attention(mesh, axis_name: str = "sp",
     sharded on T and returns the same."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, None, axis_name, None)
+    from kubegpu_tpu.parallel.sharding import fit_spec
+
+    # batch stays sharded on (dp, fsdp) and heads on tp — only the
+    # sequence axis rides the ring; a replicated in_spec would all-gather
+    # the whole batch/heads onto every sp rank
+    spec = fit_spec(mesh, P(("dp", "fsdp"), "tp", axis_name, None))
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

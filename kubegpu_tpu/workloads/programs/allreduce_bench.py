@@ -1,0 +1,68 @@
+"""ICI allreduce microbenchmark — north-star metric #2 (BASELINE.md:
+"ICI allreduce GB/s on allocated slice").
+
+Gang-scheduled onto a slice, each worker psums a buffer across the global
+mesh and measures achieved algorithmic bandwidth.  On real TPU the ring
+rides ICI (placement quality = the scheduler's job); on the CPU simulation
+it validates the full wiring (injection → jax.distributed → collective).
+
+Prints one JSON line from worker 0.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    from kubegpu_tpu.workloads.programs.distributed import init_from_env
+
+    env = init_from_env()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = Mesh(devs, ("dp",))
+    n = len(devs)
+    mib = 4.0  # MiB per device shard
+    shard_elems = int(mib * (1 << 20) // 4)
+    x = jnp.ones((jax.local_device_count(), shard_elems), jnp.float32) \
+        * (env.worker_id + 1)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), x)
+
+    @jax.jit
+    def allreduce(a):
+        return jax.lax.with_sharding_constraint(
+            jnp.broadcast_to(a.sum(axis=0, keepdims=True), a.shape),
+            NamedSharding(mesh, P("dp")))
+
+    out = allreduce(arr)  # warmup + compile
+    out.block_until_ready()
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = allreduce(arr)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    # standard busBW convention: S = the reduced buffer each rank ends
+    # with; a ring moves 2(n-1)/n * S per link
+    payload_gib = shard_elems * 4 / (1 << 30)
+    algo_gbs = (2 * (n - 1) / max(n, 1)) * payload_gib / dt
+    if env.worker_id == 0:
+        print(json.dumps({
+            "metric": "allreduce_algo_bandwidth",
+            "value": round(algo_gbs, 3),
+            "unit": "GiB/s",
+            "devices": n,
+            "payload_gib": round(payload_gib, 4),
+            "step_ms": round(dt * 1e3, 3),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

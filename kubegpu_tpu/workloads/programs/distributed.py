@@ -1,0 +1,49 @@
+"""Shared bootstrap for distributed workloads: consume the injected env.
+
+This is the workload-side half of the injection contract (SURVEY.md §4.5
+last line): the crishim set ``TPU_WORKER_ID`` / ``JAX_COORDINATOR_ADDRESS``
+/ ``JAX_NUM_PROCESSES``; ``init_from_env()`` turns them into a live
+``jax.distributed`` runtime so collectives ride the allocated slice.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass
+class WorkerEnv:
+    worker_id: int
+    num_workers: int
+    coordinator: str
+    visible_chips: list[int]
+    hostnames: list[str]
+    millitpu: int | None
+
+
+def read_env() -> WorkerEnv:
+    chips = os.environ.get("TPU_VISIBLE_CHIPS", "")
+    milli = os.environ.get("KUBETPU_MILLITPU")
+    return WorkerEnv(
+        worker_id=int(os.environ.get("TPU_WORKER_ID", "0")),
+        num_workers=int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+        coordinator=os.environ.get("JAX_COORDINATOR_ADDRESS", ""),
+        visible_chips=[int(c) for c in chips.split(",") if c != ""],
+        hostnames=[h for h in os.environ.get(
+            "TPU_WORKER_HOSTNAMES", "").split(",") if h],
+        millitpu=int(milli) if milli else None,
+    )
+
+
+def init_from_env() -> WorkerEnv:
+    """jax.distributed.initialize from the injected env (no-op for
+    single-worker pods)."""
+    env = read_env()
+    if env.num_workers > 1:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator,
+            num_processes=env.num_workers,
+            process_id=env.worker_id)
+    return env

@@ -1,0 +1,142 @@
+"""Multi-host Llama pjit training — BASELINE config 4 workload.
+
+Each gang member (one per TPU host) initializes jax.distributed from the
+injected env, joins the global mesh, and runs GSPMD-sharded train steps on
+a Llama-family model.  Optional orbax checkpointing demonstrates the
+gang-reschedule → resume story (SURVEY.md §6 checkpoint/resume).
+
+Env knobs (set via pod spec env):
+  LLAMA_PRESET   tiny (default) | 8b
+  LLAMA_STEPS    number of train steps (default 3)
+  LLAMA_MESH     e.g. "dp:2,tp:2"; defaults to the scheduler-injected
+                 KUBETPU_MESH_AXES (the mesh placement was optimized for),
+                 else dp over all devices
+  LLAMA_CKPT_DIR if set, restore at start / save at end (params AND
+                 optimizer state)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def parse_mesh(spec: str | None, n_devices: int) -> dict[str, int]:
+    """Mesh axes with graceful degradation: if the requested product
+    doesn't match the devices actually present (e.g. the CPU simulation
+    gives 1 device/process where real hosts have 4 chips), fold the axes
+    down rather than crash — dropping from the front (dp absorbs last)."""
+    axes: dict[str, int] = {}
+    if spec:
+        for part in spec.split(","):
+            k, v = part.split(":")
+            axes[k.strip()] = int(v)
+    elif os.environ.get("KUBETPU_MESH_AXES"):
+        axes = {k: int(v)
+                for k, v in json.loads(os.environ["KUBETPU_MESH_AXES"])}
+    if not axes:
+        return {"dp": n_devices}
+    prod = 1
+    for v in axes.values():
+        prod *= v
+    if prod == n_devices:
+        return axes
+    # fold: shrink axes (last-first) until the product fits, then give
+    # any remainder to dp
+    out = dict(axes)
+    for name in reversed(list(out)):
+        while out[name] > 1 and prod > n_devices:
+            if prod % 2:
+                break
+            out[name] //= 2
+            prod //= 2
+    if prod != n_devices:
+        out = {"dp": n_devices}
+    print(f"llama_pjit: folded mesh {axes} -> {out} "
+          f"for {n_devices} devices", file=sys.stderr)
+    return out
+
+
+def main() -> int:
+    from kubegpu_tpu.workloads.programs.distributed import init_from_env
+
+    env = init_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubegpu_tpu.models import (
+        LlamaConfig, llama_init, llama_param_specs,
+    )
+    from kubegpu_tpu.models.llama import make_train_step
+    from kubegpu_tpu.parallel import make_mesh, named_sharding_tree
+    from kubegpu_tpu.parallel.sharding import fit_spec
+
+    preset = os.environ.get("LLAMA_PRESET", "tiny")
+    steps = int(os.environ.get("LLAMA_STEPS", "3"))
+    cfg = (LlamaConfig.llama3_8b() if preset == "8b"
+           else LlamaConfig.tiny(n_heads=4, n_kv_heads=4, dtype="float32"))
+    axes = parse_mesh(os.environ.get("LLAMA_MESH"), jax.device_count())
+    mesh = make_mesh(axes)
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    specs = named_sharding_tree(mesh, llama_param_specs(cfg))
+    params = jax.device_put(params, specs)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+
+    ckpt_dir = os.environ.get("LLAMA_CKPT_DIR")
+    start_step = 0
+    resumed_opt = False
+    if ckpt_dir:
+        import orbax.checkpoint as ocp
+        mngr = ocp.CheckpointManager(ckpt_dir)
+        latest = mngr.latest_step()
+        if latest is not None:
+            # restore params AND optimizer state — resetting adamw
+            # moments on reschedule is a silent training regression
+            state = {"params": params, "opt_state": opt_state}
+            restored = mngr.restore(
+                latest, args=ocp.args.StandardRestore(state))
+            params = jax.device_put(restored["params"], specs)
+            opt_state = restored["opt_state"]
+            resumed_opt = True
+            start_step = latest + 1
+
+    step_fn = jax.jit(make_train_step(cfg, opt, mesh),
+                      donate_argnums=(0, 1))
+    batch = max(2, axes.get("dp", 1) * axes.get("fsdp", 1))
+    seq = 33
+    tok_sharding = NamedSharding(mesh, fit_spec(mesh, P(("dp", "fsdp"),
+                                                        None)))
+    losses = []
+    for i in range(start_step, start_step + steps):
+        tokens = (np.arange(batch * seq, dtype=np.int32)
+                  .reshape(batch, seq) * (i + 3)) % cfg.vocab_size
+        tokens = jax.device_put(jnp.asarray(tokens), tok_sharding)
+        params, opt_state, loss = step_fn(params, opt_state, tokens)
+        losses.append(float(loss))
+
+    if ckpt_dir:
+        import orbax.checkpoint as ocp
+        last = start_step + steps - 1
+        mngr.save(last, args=ocp.args.StandardSave(
+            {"params": params, "opt_state": opt_state}))
+        mngr.wait_until_finished()
+
+    if env.worker_id == 0:
+        print(f"llama_pjit: preset={preset} mesh={axes} "
+              f"workers={env.num_workers} devices={jax.device_count()} "
+              f"start_step={start_step} resumed_opt={resumed_opt} "
+              f"losses={[round(l, 4) for l in losses]}")
+    if not all(np.isfinite(losses)):
+        print("FAIL: non-finite loss", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

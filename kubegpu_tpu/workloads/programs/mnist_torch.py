@@ -1,0 +1,40 @@
+"""CPU-fallback MNIST in torch — BASELINE config 1 workload.
+
+The reference's config names a TF MNIST job; TF isn't in this image, so
+the 0-device CPU-fallback path is exercised with a torch-CPU trainer —
+the point of config 1 is that a *non-TPU, non-JAX* workload schedules and
+runs untouched (no TPU env, no device allocation).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    if os.environ.get("TPU_VISIBLE_CHIPS", ""):
+        print("FAIL: CPU-fallback pod saw TPU chips", file=sys.stderr)
+        return 2
+    import torch
+
+    torch.manual_seed(0)
+    x = torch.randn(256, 784)
+    y = torch.randint(0, 10, (256,))
+    model = torch.nn.Sequential(
+        torch.nn.Linear(784, 64), torch.nn.ReLU(), torch.nn.Linear(64, 10))
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    first = None
+    for _ in range(20):
+        opt.zero_grad()
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        first = first if first is not None else float(loss.detach())
+    print(f"mnist_torch: first_loss={first:.4f} last_loss={float(loss):.4f}")
+    return 0 if float(loss) < first else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Single-chip ResNet training — BASELINE config 2 workload.
+
+Asserts the injection granted exactly one chip, then trains a
+structure-preserving ResNet on synthetic data.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    from kubegpu_tpu.workloads.programs.distributed import read_env
+
+    env = read_env()
+    expect = os.environ.get("KUBETPU_EXPECT_CHIPS")
+    if expect is not None and len(env.visible_chips) != int(expect):
+        print(f"FAIL: expected {expect} chips, got {env.visible_chips}",
+              file=sys.stderr)
+        return 2
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubegpu_tpu.models.resnet import (
+        make_resnet_train_step, resnet_tiny, resnet50,
+    )
+
+    model = (resnet50(num_classes=100)
+             if os.environ.get("RESNET_PRESET") == "50"
+             else resnet_tiny())
+    key = jax.random.PRNGKey(0)
+    images = jax.random.normal(key, (8, 32, 32, 3))
+    labels = jnp.arange(8) % 10
+    variables = model.init(jax.random.PRNGKey(1), images, train=True)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(variables["params"])
+    step = jax.jit(make_resnet_train_step(model, opt))
+    params, bs = variables["params"], variables["batch_stats"]
+    first = None
+    for _ in range(int(os.environ.get("RESNET_STEPS", "6"))):
+        params, bs, opt_state, loss = step(params, bs, opt_state,
+                                           images, labels)
+        first = first if first is not None else float(loss)
+    print(f"resnet: first_loss={first:.4f} last_loss={float(loss):.4f} "
+          f"chips={env.visible_chips}")
+    if not float(loss) < first:
+        print("FAIL: loss did not decrease", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""The five BASELINE.json acceptance configs as submittable pod sets —
+the user surface (reference: ``example/`` YAML applied with kubectl,
+SURVEY.md §3 "Example workloads").
+
+Each builder returns (pods, expected_cluster) so tests/CLI can submit the
+workload to a ``SimCluster`` of the right slice types and watch it run
+end-to-end through schedule → inject → execute.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from kubegpu_tpu.cluster import tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, Pod
+
+PY = sys.executable or "python"
+
+
+def _prog(module: str) -> list[str]:
+    return [PY, "-m", f"kubegpu_tpu.workloads.programs.{module}"]
+
+
+def config1_cpu_mnist() -> tuple[list[Pod], list[str]]:
+    """Single-pod torch-MNIST, 0-device request (CPU fallback path)."""
+    return [tpu_pod("mnist-cpu", chips=0, command=_prog("mnist_torch"))], \
+        ["v4-8"]
+
+
+def config2_resnet_1chip() -> tuple[list[Pod], list[str]]:
+    """Single-pod JAX ResNet requesting 1 TPU chip."""
+    return [tpu_pod("resnet-1chip", chips=1,
+                    command=_prog("resnet_single"),
+                    env={"KUBETPU_EXPECT_CHIPS": "1"})], ["v4-8"]
+
+
+def config3_dp_gang(steps: int = 2) -> tuple[list[Pod], list[str]]:
+    """4-pod data-parallel gang on one v4-8 host (intra-host ICI)."""
+    pods = [
+        tpu_pod(f"dp-{i}", chips=1,
+                gang=GangSpec(name="dp-mnist", size=4, index=i),
+                mesh_axes={"dp": 4},
+                command=_prog("llama_pjit"),
+                env={"LLAMA_STEPS": str(steps)})
+        for i in range(4)
+    ]
+    return pods, ["v4-8"]
+
+
+def config4_llama_v5e16(steps: int = 2) -> tuple[list[Pod], list[str]]:
+    """Multi-host JAX pjit Llama on v5e-16 (4 hosts × 4 chips, dp×tp)."""
+    pods = [
+        tpu_pod(f"llama-{i}", chips=4,
+                gang=GangSpec(name="llama-8b", size=4, index=i),
+                mesh_axes={"dp": 4, "tp": 4},
+                command=_prog("llama_pjit"),
+                env={"LLAMA_STEPS": str(steps)})
+        for i in range(4)
+    ]
+    return pods, ["v5e-16"]
+
+
+def config5_multitenant() -> tuple[list[Pod], list[str]]:
+    """Two co-tenant jobs: fractional-chip pods + a slice gang
+    (bin-packing)."""
+    pods = [
+        tpu_pod("tenant-a-frac", millitpu=400,
+                command=_prog("resnet_single")),
+        tpu_pod("tenant-a-frac2", millitpu=500,
+                command=_prog("resnet_single")),
+    ]
+    pods += [
+        tpu_pod(f"tenant-b-{i}", chips=4,
+                gang=GangSpec(name="tenant-b", size=2, index=i),
+                mesh_axes={"dp": 2, "tp": 4},
+                command=_prog("llama_pjit"),
+                env={"LLAMA_STEPS": "2"})
+        for i in range(2)
+    ]
+    return pods, ["v5e-16"]
+
+
+def allreduce_gang(n_pods: int = 4,
+                   slice_type: str = "v4-8") -> tuple[list[Pod], list[str]]:
+    """The ICI-allreduce microbenchmark gang (north-star metric #2)."""
+    pods = [
+        tpu_pod(f"allreduce-{i}", chips=1,
+                gang=GangSpec(name="allreduce", size=n_pods, index=i),
+                mesh_axes={"dp": n_pods},
+                command=_prog("allreduce_bench"))
+        for i in range(n_pods)
+    ]
+    return pods, [slice_type]
+
+
+ALL_CONFIGS = {
+    "config1": config1_cpu_mnist,
+    "config2": config2_resnet_1chip,
+    "config3": config3_dp_gang,
+    "config4": config4_llama_v5e16,
+    "config5": config5_multitenant,
+    "allreduce": allreduce_gang,
+}

@@ -65,6 +65,15 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_causal_t_gt_s_rejected(self):
+        """Regression (review): t > s causal is ill-defined — both
+        implementations must refuse rather than return garbage."""
+        q, k, v = rand_qkv(jax.random.PRNGKey(9), t=128, s=64)
+        with pytest.raises(ValueError):
+            xla_attention(q, k, v, causal=True)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, causal=True, interpret=True)
+
     def test_odd_shapes_fall_back(self):
         q, k, v = rand_qkv(jax.random.PRNGKey(3), t=100, s=100)
         out = flash_attention(q, k, v, causal=True, interpret=True)
