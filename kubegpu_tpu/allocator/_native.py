@@ -1,0 +1,217 @@
+"""ctypes bindings for the C++ allocator core (csrc/allocator_core.cpp).
+
+The reference's hot loop was native Go; KubeTPU's is C++ behind a C ABI —
+pybind11 isn't available in this environment, so the bindings are plain
+ctypes over flat int32/float64 arrays (SURVEY.md §8 step 3).
+
+Loading is lazy and fail-soft: on first use we build the shared library
+with the csrc Makefile if it's missing or stale, and if anything goes
+wrong (no compiler, exotic platform) every entry point returns ``None`` so
+callers fall back to the pure-Python reference implementations.  Set
+``KUBETPU_NO_NATIVE=1`` to force the Python path (used by parity tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+from kubegpu_tpu.topology.mesh import Coord, TpuTopology
+
+_CSRC = Path(__file__).parent / "csrc"
+_SO = _CSRC / "libktpu_alloc.so"
+
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    src = _CSRC / "allocator_core.cpp"
+    try:
+        if _SO.exists() and (
+                not src.exists()  # prebuilt .so shipped without source
+                or _SO.stat().st_mtime >= src.stat().st_mtime):
+            return True
+        subprocess.run(
+            ["make", "-s"], cwd=_CSRC, check=True,
+            capture_output=True, timeout=120)
+        return _SO.exists()
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    if os.environ.get("KUBETPU_NO_NATIVE"):
+        return None
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    if not _build():
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+    except OSError:
+        _load_failed = True
+        return None
+    i32 = ctypes.c_int32
+    lib.ktpu_find_free_placements.restype = i32
+    lib.ktpu_find_free_placements.argtypes = [
+        i32, i32, i32, i32, i32, i32,
+        ctypes.POINTER(ctypes.c_uint8), i32, i32, i32,
+        i32, i32, ctypes.POINTER(i32), ctypes.POINTER(i32)]
+    lib.ktpu_eval_order.restype = ctypes.c_double
+    lib.ktpu_eval_order.argtypes = [
+        i32, i32, i32, i32, i32, i32,
+        ctypes.POINTER(i32), i32, ctypes.POINTER(i32),
+        ctypes.POINTER(ctypes.c_double), i32]
+    lib.ktpu_fragmentation_score.restype = ctypes.c_double
+    lib.ktpu_fragmentation_score.argtypes = [
+        i32, i32, i32, i32, i32, i32,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(i32), i32]
+    lib.ktpu_orient_rings.restype = i32
+    lib.ktpu_orient_rings.argtypes = [
+        ctypes.POINTER(i32), ctypes.POINTER(i32), ctypes.POINTER(i32),
+        i32, i32, ctypes.POINTER(i32)]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# -- marshalling helpers ----------------------------------------------------
+
+def _occupancy_mask(topo: TpuTopology, occupied: set[Coord]) -> ctypes.Array:
+    mx, my, mz = topo.spec.mesh_shape
+    buf = (ctypes.c_uint8 * (mx * my * mz))()
+    for (x, y, z) in occupied:
+        if 0 <= x < mx and 0 <= y < my and 0 <= z < mz:
+            buf[(x * my + y) * mz + z] = 1
+    return buf
+
+
+def _coords_array(coords: list[Coord]) -> ctypes.Array:
+    buf = (ctypes.c_int32 * (len(coords) * 3))()
+    k = 0
+    for (x, y, z) in coords:
+        buf[k] = x
+        buf[k + 1] = y
+        buf[k + 2] = z
+        k += 3
+    return buf
+
+
+# -- entry points (None = fall back to Python) ------------------------------
+
+def find_free_placements_native(
+    topo: TpuTopology, occupied: set[Coord], shape: Coord,
+    limit: int | None):
+    lib = get_lib()
+    if lib is None:
+        return None
+    mx, my, mz = topo.spec.mesh_shape
+    wx, wy, wz = topo.spec.wrap
+    sx, sy, sz = shape
+    vol = sx * sy * sz
+    if vol == 0:
+        return []
+    # worst-case placements = product of per-axis origin counts
+    max_out = 1
+    for dim, size, wrap in zip((mx, my, mz), shape, (wx, wy, wz)):
+        max_out *= dim if (wrap and dim > 2 and size < dim) else max(
+            dim - size + 1, 0)
+    if max_out == 0:
+        return []
+    occ = _occupancy_mask(topo, occupied)
+    origins = (ctypes.c_int32 * (max_out * 3))()
+    coords = (ctypes.c_int32 * (max_out * vol * 3))()
+    n = lib.ktpu_find_free_placements(
+        mx, my, mz, int(wx), int(wy), int(wz), occ, sx, sy, sz,
+        0 if limit is None else limit, max_out, origins, coords)
+    if n < 0:
+        return None  # mesh too large for the native key; python fallback
+    from kubegpu_tpu.topology.slices import Placement
+    out = []
+    for i in range(n):
+        base = i * vol * 3
+        cs = tuple(
+            (coords[base + j * 3], coords[base + j * 3 + 1],
+             coords[base + j * 3 + 2])
+            for j in range(vol))
+        out.append(Placement(
+            origin=(origins[i * 3], origins[i * 3 + 1], origins[i * 3 + 2]),
+            shape=shape, coords=cs))
+    return out
+
+
+def eval_order_native(
+    topo: TpuTopology, order: list[Coord], axes: dict[str, int],
+    axis_weights: dict[str, float] | None):
+    lib = get_lib()
+    if lib is None:
+        return None
+    # cross-mesh coords (DCN pairs) only arise in multi-slice scoring,
+    # which stays on the python path
+    for c in order:
+        if not topo.has_coord(c):
+            return None
+    mx, my, mz = topo.spec.mesh_shape
+    wx, wy, wz = topo.spec.wrap
+    names = list(axes.keys())
+    sizes = (ctypes.c_int32 * len(names))(*[axes[k] for k in names])
+    w = axis_weights or {}
+    weights = (ctypes.c_double * len(names))(
+        *[float(w.get(k, 1.0)) for k in names])
+    res = lib.ktpu_eval_order(
+        mx, my, mz, int(wx), int(wy), int(wz),
+        _coords_array(order), len(order), sizes, weights, len(names))
+    if res < 0:
+        raise ValueError(f"mesh axes {axes} ≠ {len(order)} chips")
+    return res
+
+
+def orient_rings_native(options: list[list[list[Coord]]],
+                        close: bool) -> list[Coord] | None:
+    """Native Viterbi over per-block orientation options (gang.py
+    ``_orient_rings``).  ``options[b]`` is block b's orientation list."""
+    lib = get_lib()
+    if lib is None or not options:
+        return None
+    n_blocks = len(options)
+    n_opts = (ctypes.c_int32 * n_blocks)(*[len(o) for o in options])
+    opt_len = (ctypes.c_int32 * n_blocks)(*[len(o[0]) for o in options])
+    flat: list[int] = []
+    for block in options:
+        for opt in block:
+            for (x, y, z) in opt:
+                flat.extend((x, y, z))
+    data = (ctypes.c_int32 * len(flat))(*flat)
+    choice = (ctypes.c_int32 * n_blocks)()
+    rc = lib.ktpu_orient_rings(
+        data, n_opts, opt_len, n_blocks, int(close), choice)
+    if rc != 0:
+        return None
+    out: list[Coord] = []
+    for b in range(n_blocks):
+        out.extend(options[b][choice[b]])
+    return out
+
+
+def fragmentation_score_native(
+    topo: TpuTopology, occupied: set[Coord], coords: tuple[Coord, ...]):
+    lib = get_lib()
+    if lib is None:
+        return None
+    mx, my, mz = topo.spec.mesh_shape
+    wx, wy, wz = topo.spec.wrap
+    occ = _occupancy_mask(topo, occupied)
+    return lib.ktpu_fragmentation_score(
+        mx, my, mz, int(wx), int(wy), int(wz), occ,
+        _coords_array(list(coords)), len(coords))

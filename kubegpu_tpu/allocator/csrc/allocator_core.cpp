@@ -1,0 +1,332 @@
+// KubeTPU native allocator core — the schedule-latency hot loop.
+//
+// Reference parity: the reference's hot loop was Go
+// (grpalloc.PodFitsGroupConstraints, SURVEY.md §3/§4.2); KubeTPU's native
+// equivalent is this C++ core behind a C ABI consumed via ctypes
+// (kubegpu_tpu/allocator/_native.py).  Semantics are bit-for-bit identical
+// to the Python reference implementations in topology/slices.py
+// (find_free_placements) and topology/locality.py (+allocator/ordering.py:
+// evaluate_order) — tests/test_native.py asserts parity on random cases.
+//
+// Layout conventions (shared with the Python side):
+//   - mesh cells are indexed row-major, z fastest: idx = (x*my + y)*mz + z
+//   - coords cross the ABI as flat int32 triples [x0,y0,z0, x1,y1,z1, ...]
+//   - occupancy is a uint8 mask over cell indices (1 = blocked)
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct MeshView {
+  int mx, my, mz;
+  bool wx, wy, wz;
+
+  int dim(int axis) const { return axis == 0 ? mx : (axis == 1 ? my : mz); }
+  bool wrap(int axis) const { return axis == 0 ? wx : (axis == 1 ? wy : wz); }
+  int cell(int x, int y, int z) const { return (x * my + y) * mz + z; }
+  int ncells() const { return mx * my * mz; }
+
+  // Torus manhattan distance honoring wraparound (mesh.py hop_distance):
+  // wrap reduces an axis delta only when that axis wraps AND dim > 2.
+  int hop(const int32_t* a, const int32_t* b) const {
+    int d = 0;
+    for (int axis = 0; axis < 3; ++axis) {
+      int dm = dim(axis);
+      int delta = a[axis] - b[axis];
+      if (delta < 0) delta = -delta;
+      if (wrap(axis) && dm > 2) {
+        int other = dm - delta;
+        if (other < delta) delta = other;
+      }
+      d += delta;
+    }
+    return d;
+  }
+};
+
+// 128-bit-ish key for a placement's coord-set, for wrapped-placement dedup
+// (slices.py enumerate_placements canonicalizes duplicate coord-sets away).
+// Meshes up to 512 cells are covered by 8x64 bits; bigger meshes fall back
+// to hashing the sorted cell list.
+struct SetKey {
+  uint64_t w[8];
+  bool operator==(const SetKey& o) const {
+    return std::memcmp(w, o.w, sizeof(w)) == 0;
+  }
+};
+struct SetKeyHash {
+  size_t operator()(const SetKey& k) const {
+    uint64_t h = 1469598103934665603ull;
+    for (uint64_t v : k.w) {
+      h ^= v;
+      h *= 1099511628211ull;
+    }
+    return (size_t)h;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Enumerate free contiguous placements of shape (sx,sy,sz), honoring
+// per-axis wraparound, skipping any placement touching an occupied cell,
+// stopping after `limit` results (limit<=0 means unlimited).
+//
+// Origin enumeration order matches slices.py (_axis_origins nesting
+// ox→oy→oz; wrapped axes with dim>2 and size<dim contribute all origins),
+// and each placement's coords are emitted in local row-major order
+// (dx outer, dz inner) — downstream worker ordering relies on this.
+//
+// out_origins: capacity >= limit*3 ints; out_coords: >= limit*vol*3 ints.
+// Returns the number of placements written, or -1 if the caller's buffers
+// would overflow (cap = max_out placements).
+int32_t ktpu_find_free_placements(
+    int32_t mx, int32_t my, int32_t mz, int32_t wx, int32_t wy, int32_t wz,
+    const uint8_t* occupied, int32_t sx, int32_t sy, int32_t sz,
+    int32_t limit, int32_t max_out, int32_t* out_origins,
+    int32_t* out_coords) {
+  MeshView m{mx, my, mz, wx != 0, wy != 0, wz != 0};
+  if (sx > mx || sy > my || sz > mz) return 0;
+  if (m.ncells() > 512) return -2;  // key width exceeded; caller falls back
+
+  auto origins = [&](int axis, int size) {
+    int dm = m.dim(axis);
+    // wrapped placements are legal on a torus axis (dim>2) when the
+    // placement does not already span the full axis
+    int n = (m.wrap(axis) && dm > 2 && size < dm) ? dm : dm - size + 1;
+    return n;
+  };
+
+  std::unordered_set<SetKey, SetKeyHash> seen;
+  seen.reserve(256);
+  const int vol = sx * sy * sz;
+  int32_t nout = 0;
+  std::vector<int32_t> coords(vol * 3);
+
+  const int nox = origins(0, sx), noy = origins(1, sy), noz = origins(2, sz);
+  for (int ox = 0; ox < nox; ++ox) {
+    for (int oy = 0; oy < noy; ++oy) {
+      for (int oz = 0; oz < noz; ++oz) {
+        SetKey key{};
+        bool free_ok = true;
+        int k = 0;
+        for (int dx = 0; dx < sx; ++dx) {
+          int x = ox + dx;
+          if (x >= mx) x -= mx;
+          for (int dy = 0; dy < sy; ++dy) {
+            int y = oy + dy;
+            if (y >= my) y -= my;
+            for (int dz = 0; dz < sz; ++dz) {
+              int z = oz + dz;
+              if (z >= mz) z -= mz;
+              int c = m.cell(x, y, z);
+              key.w[c >> 6] |= (1ull << (c & 63));
+              if (occupied[c]) free_ok = false;
+              coords[k++] = x;
+              coords[k++] = y;
+              coords[k++] = z;
+            }
+          }
+        }
+        // dedup applies to ALL enumerated placements (python dedups in
+        // enumerate_placements before the occupancy filter)
+        if (!seen.insert(key).second) continue;
+        if (!free_ok) continue;
+        if (nout >= max_out) return -1;
+        out_origins[nout * 3 + 0] = ox;
+        out_origins[nout * 3 + 1] = oy;
+        out_origins[nout * 3 + 2] = oz;
+        std::memcpy(out_coords + (size_t)nout * vol * 3, coords.data(),
+                    sizeof(int32_t) * vol * 3);
+        ++nout;
+        if (limit > 0 && nout >= limit) return nout;
+      }
+    }
+  }
+  return nout;
+}
+
+// Weighted ICI locality of a logical device order under a workload's mesh
+// axes — locality.py traffic_pairs_for_mesh_axes + ici_locality fused.
+//
+// order: n coord triples, logical-device order (last axis varies fastest).
+// axis_sizes/axis_weights: n_axes parallel arrays; product(sizes) must be n.
+// Every axis of size s contributes ring pairs (k, k+1 mod s) within each
+// group varying only along that axis; s==2 contributes one pair per group.
+// A pair counts as local iff its torus hop distance is exactly 1 (the
+// neighbor relation in mesh.py).  Returns locality in [0,1]; 1.0 when no
+// pairs.  Returns -1.0 on size mismatch.
+double ktpu_eval_order(int32_t mx, int32_t my, int32_t mz, int32_t wx,
+                       int32_t wy, int32_t wz, const int32_t* order,
+                       int32_t n, const int32_t* axis_sizes,
+                       const double* axis_weights, int32_t n_axes) {
+  MeshView m{mx, my, mz, wx != 0, wy != 0, wz != 0};
+  int64_t total_chips = 1;
+  for (int i = 0; i < n_axes; ++i) total_chips *= axis_sizes[i];
+  if (total_chips != n) return -1.0;
+
+  // strides for row-major logical indexing (last axis fastest)
+  std::vector<int64_t> strides(n_axes, 1);
+  for (int i = n_axes - 2; i >= 0; --i)
+    strides[i] = strides[i + 1] * axis_sizes[i + 1];
+
+  double total_w = 0.0, local_w = 0.0;
+  for (int ax = 0; ax < n_axes; ++ax) {
+    const int s = axis_sizes[ax];
+    if (s <= 1) continue;
+    const double w = axis_weights[ax];
+    const int64_t stride = strides[ax];
+    for (int64_t base = 0; base < n; ++base) {
+      if ((base / stride) % s != 0) continue;
+      const int upto = (s == 2) ? 1 : s;  // 2-ring has one unique pair
+      for (int k = 0; k < upto; ++k) {
+        const int32_t* a = order + (base + (int64_t)k * stride) * 3;
+        const int32_t* b = order + (base + (int64_t)((k + 1) % s) * stride) * 3;
+        if (a[0] == b[0] && a[1] == b[1] && a[2] == b[2]) continue;
+        total_w += w;
+        if (m.hop(a, b) == 1) local_w += w;
+      }
+    }
+  }
+  return total_w == 0.0 ? 1.0 : local_w / total_w;
+}
+
+// Viterbi orientation chaining (gang.py _orient_rings): choose one
+// orientation option per host block so each block's entry chip sits next
+// to the previous block's exit chip; with `close`, also optimize the wrap
+// transition (last block's exit → first block's entry), trying every
+// option of block 0 as the start.  This is the measured hot loop of the
+// schedule path (the p50-latency metric's inner kernel).
+//
+// opts_data: concatenated coord triples of every option of every block,
+//   laid out block-major then option-major:
+//   block0.opt0, block0.opt1, ..., block1.opt0, ...
+// n_opts[b], opt_len[b]: option count / coords-per-option of block b.
+// out_choice[b]: chosen option index per block.
+// Tie-breaking matches the Python reference exactly: strict improvement,
+// starts and options visited in index order.  Returns 0 on success.
+int32_t ktpu_orient_rings(const int32_t* opts_data, const int32_t* n_opts,
+                          const int32_t* opt_len, int32_t n_blocks,
+                          int32_t close, int32_t* out_choice) {
+  if (n_blocks <= 0) return -1;
+  // per-block offsets into opts_data (in int32 units)
+  std::vector<int64_t> block_off(n_blocks);
+  int64_t off = 0;
+  int max_opts = 0;
+  for (int b = 0; b < n_blocks; ++b) {
+    block_off[b] = off;
+    off += (int64_t)n_opts[b] * opt_len[b] * 3;
+    if (n_opts[b] > max_opts) max_opts = n_opts[b];
+  }
+  auto opt_ptr = [&](int b, int j) {
+    return opts_data + block_off[b] + (int64_t)j * opt_len[b] * 3;
+  };
+  // entry coord of option = first triple; exit coord = last triple
+  auto trans = [&](int pb, int pj, int nb, int nj) -> int64_t {
+    const int32_t* prev = opt_ptr(pb, pj) + (opt_len[pb] - 1) * 3;  // exit
+    const int32_t* nxt = opt_ptr(nb, nj);                           // entry
+    int d = 0;
+    for (int k = 0; k < 3; ++k) {
+      int delta = prev[k] - nxt[k];
+      d += delta < 0 ? -delta : delta;
+    }
+    return d == 1 ? 0 : d;
+  };
+  if (n_blocks == 1) {
+    out_choice[0] = 0;
+    return 0;
+  }
+
+  const int n_starts = close ? n_opts[0] : 1;
+  std::vector<int64_t> cost(max_opts), ncost(max_opts);
+  // back[i-1][j] = predecessor option at block i-1 for option j at block i
+  std::vector<int32_t> back((size_t)(n_blocks - 1) * max_opts);
+  std::vector<int32_t> best_path(n_blocks);
+  int64_t best_total = -1;
+
+  for (int start = 0; start < n_starts; ++start) {
+    // block 0 is pinned to `start`
+    int prev_count = 1;
+    cost[0] = 0;
+    for (int i = 1; i < n_blocks; ++i) {
+      for (int j = 0; j < n_opts[i]; ++j) {
+        int64_t bestc = -1;
+        int32_t bestj = 0;
+        for (int pj = 0; pj < prev_count; ++pj) {
+          const int real_pj = (i == 1) ? start : pj;
+          int64_t c = cost[pj] + trans(i - 1, real_pj, i, j);
+          if (bestc < 0 || c < bestc) {
+            bestc = c;
+            bestj = pj;
+          }
+        }
+        ncost[j] = bestc;
+        back[(size_t)(i - 1) * max_opts + j] = bestj;
+      }
+      prev_count = n_opts[i];
+      std::swap(cost, ncost);
+    }
+    for (int j = 0; j < n_opts[n_blocks - 1]; ++j) {
+      int64_t total = cost[j];
+      if (close) total += trans(n_blocks - 1, j, 0, start);
+      if (best_total < 0 || total < best_total) {
+        best_total = total;
+        // backtrack
+        int cur = j;
+        for (int i = n_blocks - 1; i >= 1; --i) {
+          best_path[i] = cur;
+          cur = back[(size_t)(i - 1) * max_opts + cur];
+        }
+        best_path[0] = start;
+      }
+    }
+  }
+  for (int b = 0; b < n_blocks; ++b) out_choice[b] = best_path[b];
+  return 0;
+}
+
+// Packing heuristic (slices.py fragmentation_score): fraction of the
+// placement's boundary (neighbor slots outside it) that is off-mesh or
+// occupied.  coords: vol triples; occupied mask as above.
+double ktpu_fragmentation_score(int32_t mx, int32_t my, int32_t mz,
+                                int32_t wx, int32_t wy, int32_t wz,
+                                const uint8_t* occupied,
+                                const int32_t* coords, int32_t vol) {
+  MeshView m{mx, my, mz, wx != 0, wy != 0, wz != 0};
+  std::vector<uint8_t> inplace(m.ncells(), 0);
+  for (int i = 0; i < vol; ++i) {
+    const int32_t* c = coords + i * 3;
+    inplace[m.cell(c[0], c[1], c[2])] = 1;
+  }
+  int64_t boundary = 0, blocked = 0;
+  for (int i = 0; i < vol; ++i) {
+    const int32_t* c = coords + i * 3;
+    for (int axis = 0; axis < 3; ++axis) {
+      const int dm = m.dim(axis);
+      for (int delta = -1; delta <= 1; delta += 2) {
+        int nc[3] = {c[0], c[1], c[2]};
+        nc[axis] += delta;
+        if (nc[axis] < 0 || nc[axis] >= dm) {
+          if (m.wrap(axis) && dm > 2) {
+            nc[axis] = ((nc[axis] % dm) + dm) % dm;
+          } else {
+            ++boundary;
+            ++blocked;  // mesh wall counts as packed-against
+            continue;
+          }
+        }
+        const int cell = m.cell(nc[0], nc[1], nc[2]);
+        if (inplace[cell]) continue;
+        ++boundary;
+        if (occupied[cell]) ++blocked;
+      }
+    }
+  }
+  return boundary ? (double)blocked / (double)boundary : 1.0;
+}
+
+}  // extern "C"

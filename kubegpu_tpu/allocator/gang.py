@@ -280,6 +280,11 @@ def _orient_rings(blocks: list[list[Coord]], close: bool = False) -> list[Coord]
     options = [_block_cycle_options(b) for b in blocks]
     if len(blocks) == 1:
         return list(options[0][0])
+    from kubegpu_tpu.allocator import _native
+
+    native = _native.orient_rings_native(options, close)
+    if native is not None:
+        return native
 
     def trans_cost(prev_opt: list[Coord], nxt_opt: list[Coord]) -> int:
         d = _dist(prev_opt[-1], nxt_opt[0])

@@ -36,6 +36,11 @@ def evaluate_order(
     axis_weights: dict[str, float] | None = None,
 ) -> float:
     """Weighted ICI locality of a candidate logical order."""
+    from kubegpu_tpu.allocator import _native
+
+    native = _native.eval_order_native(topo, order, axes, axis_weights)
+    if native is not None:
+        return native
     tm = traffic_pairs_for_mesh_axes(order, axes, axis_weights)
     return ici_locality(topo, tm)
 

@@ -114,6 +114,11 @@ def find_free_placements(
     verb (SURVEY.md §4.2).  ``limit`` caps the returned candidates so the
     prioritize step scores a bounded set.
     """
+    from kubegpu_tpu.allocator import _native
+
+    native = _native.find_free_placements_native(topo, occupied, shape, limit)
+    if native is not None:
+        return native
     out: list[Placement] = []
     for p in enumerate_placements(topo, shape):
         if not any(c in occupied for c in p.coords):
@@ -161,6 +166,12 @@ def fragmentation_score(topo: TpuTopology, occupied: set[Coord],
     higher means tighter packing, leaving larger free blocks for future
     gangs (the bin-packing pressure case, BASELINE config 5).
     """
+    from kubegpu_tpu.allocator import _native
+
+    native = _native.fragmentation_score_native(
+        topo, occupied, placement.coords)
+    if native is not None:
+        return native
     pset = set(placement.coords)
     boundary = 0
     blocked = 0

@@ -1,0 +1,233 @@
+"""Parity + sanitizer tests for the C++ allocator core.
+
+SURVEY.md §8 step 3: the hot loop gets a C++ port, property-tested hard —
+random meshes × random occupancy × random shapes must produce *identical*
+results from the native core and the pure-Python reference implementations
+(which stay in-tree as the spec).  §6: the core also builds and runs under
+-fsanitize=address,undefined.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from kubegpu_tpu.allocator import _native
+from kubegpu_tpu.allocator.ordering import candidate_orders
+from kubegpu_tpu.topology.locality import (
+    ici_locality,
+    traffic_pairs_for_mesh_axes,
+)
+from kubegpu_tpu.topology.mesh import TOPOLOGY_REGISTRY, TpuTopology
+from kubegpu_tpu.topology.slices import (
+    Placement,
+    enumerate_placements,
+    find_free_placements,
+    fragmentation_score,
+    subslice_shapes,
+)
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(), reason="native core unavailable (no g++?)")
+
+
+class _python_path:
+    """Run the real production functions with the native core disabled —
+    the Python implementations in-tree ARE the spec the core must match."""
+
+    def __enter__(self):
+        os.environ["KUBETPU_NO_NATIVE"] = "1"
+
+    def __exit__(self, *exc):
+        os.environ.pop("KUBETPU_NO_NATIVE", None)
+
+
+def _py_find_free(topo, occupied, shape, limit):
+    with _python_path():
+        return find_free_placements(topo, occupied, shape, limit)
+
+
+def _py_frag(topo, occupied, placement):
+    with _python_path():
+        return fragmentation_score(topo, occupied, placement)
+
+
+def _random_axes(rng, n):
+    """Random ordered factorization of n into 1–3 named axes."""
+    names = ["dp", "fsdp", "tp"]
+    sizes = []
+    rest = n
+    for _ in range(rng.randrange(1, 3)):
+        divs = [d for d in range(2, rest + 1) if rest % d == 0]
+        if not divs:
+            break
+        d = rng.choice(divs)
+        sizes.append(d)
+        rest //= d
+    sizes.append(rest)
+    return {names[i]: s for i, s in enumerate(sizes)}
+
+
+@pytest.mark.parametrize("topo_name", sorted(TOPOLOGY_REGISTRY))
+def test_find_free_placements_parity(topo_name):
+    topo = TpuTopology.build(TOPOLOGY_REGISTRY[topo_name])
+    rng = random.Random(hash(topo_name) & 0xFFFF)
+    all_coords = [ch.coord for ch in topo.chips]
+    n = topo.spec.num_chips
+    for trial in range(30):
+        occupied = set(rng.sample(all_coords, rng.randrange(0, n)))
+        total = rng.choice([1, 2, 4, 8, 16, 32])
+        if total > n:
+            continue
+        for shape in subslice_shapes(total, topo.spec.mesh_shape):
+            for limit in (None, 3):
+                py = _py_find_free(topo, occupied, shape, limit)
+                nat = _native.find_free_placements_native(
+                    topo, occupied, shape, limit)
+                assert nat is not None
+                assert [p.origin for p in nat] == [p.origin for p in py]
+                assert [p.coords for p in nat] == [p.coords for p in py]
+
+
+@pytest.mark.parametrize("topo_name", ["v5e-16", "v5e-64", "v5e-256",
+                                       "v4-16", "v5p-128"])
+def test_eval_order_parity(topo_name):
+    topo = TpuTopology.build(TOPOLOGY_REGISTRY[topo_name])
+    rng = random.Random(42)
+    for total in (4, 8, 16):
+        if total > topo.spec.num_chips:
+            continue
+        for shape in subslice_shapes(total, topo.spec.mesh_shape)[:3]:
+            pls = enumerate_placements(topo, shape)[:4]
+            for pl in pls:
+                for order in candidate_orders(pl)[:6]:
+                    axes = _random_axes(rng, total)
+                    weights = {k: rng.choice([1.0, 2.0, 8.0])
+                               for k in axes}
+                    py = ici_locality(
+                        topo,
+                        traffic_pairs_for_mesh_axes(order, axes, weights))
+                    nat = _native.eval_order_native(
+                        topo, order, axes, weights)
+                    assert nat is not None
+                    assert nat == pytest.approx(py, abs=1e-12), (
+                        topo_name, shape, axes, weights)
+
+
+@pytest.mark.parametrize("topo_name", ["v5e-64", "v5e-256", "v5p-128"])
+def test_fragmentation_parity(topo_name):
+    topo = TpuTopology.build(TOPOLOGY_REGISTRY[topo_name])
+    rng = random.Random(7)
+    all_coords = [ch.coord for ch in topo.chips]
+    for _ in range(20):
+        occupied = set(rng.sample(all_coords,
+                                  rng.randrange(0, len(all_coords) // 2)))
+        total = rng.choice([4, 8, 16])
+        shape = rng.choice(subslice_shapes(total, topo.spec.mesh_shape))
+        pls = _py_find_free(topo, occupied, shape, 5)
+        for pl in pls:
+            py = _py_frag(topo, occupied, pl)
+            nat = _native.fragmentation_score_native(
+                topo, occupied, pl.coords)
+            assert nat == pytest.approx(py, abs=1e-12)
+
+
+@pytest.mark.parametrize("topo_name", ["v5e-16", "v5e-64", "v5e-256"])
+def test_orient_rings_parity(topo_name, monkeypatch):
+    """_orient_rings (the measured hot loop) picks identical orientations
+    native vs python across placements of many shapes."""
+    from kubegpu_tpu.allocator import gang as gang_mod
+
+    topo = TpuTopology.build(TOPOLOGY_REGISTRY[topo_name])
+    for total in (8, 16, 32, 64):
+        if total > topo.spec.num_chips:
+            continue
+        for shape in subslice_shapes(total, topo.spec.mesh_shape)[:4]:
+            for pl in enumerate_placements(topo, shape)[:3]:
+                for span in (None, 16):
+                    monkeypatch.setenv("KUBETPU_NO_NATIVE", "1")
+                    py = gang_mod._block_orders(topo, pl, span)
+                    monkeypatch.delenv("KUBETPU_NO_NATIVE")
+                    nat = gang_mod._block_orders(topo, pl, span)
+                    assert nat == py, (topo_name, shape, pl.origin, span)
+
+
+def test_connected_set_fragmentation():
+    """Degenerate (non-rectangular) placements also go through native."""
+    topo = TpuTopology.build(TOPOLOGY_REGISTRY["v5e-16"])
+    coords = ((0, 0, 0), (0, 1, 0), (1, 0, 0))
+    pl = Placement(origin=(0, 0, 0), shape=(0, 0, 0), coords=coords)
+    occupied = {(1, 1, 0), (2, 0, 0)}
+    assert _native.fragmentation_score_native(
+        topo, occupied, pl.coords) == pytest.approx(
+        _py_frag(topo, occupied, pl), abs=1e-12)
+
+
+def test_allocator_end_to_end_native_vs_python(monkeypatch):
+    """Full GangAllocator decisions are identical with the core on/off."""
+    from kubegpu_tpu.allocator import _native as nat_mod
+    from kubegpu_tpu.allocator.gang import GangAllocator, GangRequest
+    from kubegpu_tpu.allocator.gang import SliceState
+    from kubegpu_tpu.tpuplugin.mock import MockBackend
+
+    def build_slices():
+        spec = MockBackend("v5e-64", slice_id="s0").spec
+        advs = [MockBackend("v5e-64", host_id=h, slice_id="s0").discover()
+                for h in range(spec.num_hosts)]
+        return [SliceState.from_advertisements(advs)]
+
+    reqs = [
+        GangRequest("g0", num_pods=4, chips_per_pod=4,
+                    mesh_axes={"dp": 4, "tp": 4}),
+        GangRequest("g1", num_pods=8, chips_per_pod=4,
+                    mesh_axes={"dp": 2, "tp": 16},
+                    axis_weights={"dp": 1.0, "tp": 8.0}),
+        GangRequest("g2", num_pods=1, chips_per_pod=2),
+        GangRequest("g3", num_pods=1, chips_per_pod=3),  # connected-set path
+        GangRequest("g4", num_pods=2, chips_per_pod=3),  # may be infeasible
+    ]
+
+    def run(native: bool):
+        if not native:
+            monkeypatch.setenv("KUBETPU_NO_NATIVE", "1")
+        else:
+            monkeypatch.delenv("KUBETPU_NO_NATIVE", raising=False)
+        slices = build_slices()
+        alloc = GangAllocator()
+        out = []
+        for r in reqs:
+            a = alloc.find_assignment(slices, r)
+            if a is None:
+                out.append(None)
+                continue
+            alloc.commit({s.slice_id: s for s in slices}, a)
+            out.append((a.slice_id, a.locality, a.score,
+                        [(p.pod_index, p.host_id,
+                          tuple(c.coord for c in p.chips))
+                         for p in a.pods]))
+        return out
+
+    native_out = run(True)
+    python_out = run(False)
+    assert native_out == python_out
+
+
+def test_asan_build_and_run():
+    """Build and run the address+UB-sanitized driver over every exported
+    entry point (SURVEY.md §6 race/sanitizer row)."""
+    csrc = Path(_native.__file__).parent / "csrc"
+    try:
+        subprocess.run(["make", "-s", "asan"], cwd=csrc, check=True,
+                       capture_output=True, timeout=180)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"asan build unavailable: {e}")
+    res = subprocess.run(
+        [str(csrc / "sanitize_check")], capture_output=True, text=True,
+        timeout=120, env={"PATH": "/usr/bin:/bin",
+                          "ASAN_OPTIONS": "detect_leaks=0"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "sanitize OK" in res.stdout
