@@ -118,6 +118,7 @@ class SliceState:
         self.ip_of_host: dict[int, str] = {}
         self.available: set[Coord] = set()     # advertised by some node
         self.unhealthy: set[Coord] = set()
+        self.bad_links: set[tuple[Coord, Coord]] = set()  # normalized pairs
         self.local_index: dict[Coord, int] = {}
         self.used_millichips: dict[Coord, int] = {}
 
@@ -143,6 +144,8 @@ class SliceState:
                 st.local_index[c.coord] = c.local_index
                 if not c.healthy:
                     st.unhealthy.add(c.coord)
+            for pair in a.bad_links:
+                st.bad_links.add((min(pair), max(pair)))
         return st
 
     # -- occupancy -------------------------------------------------------
@@ -188,6 +191,7 @@ class SliceState:
                        for i in self.topo.hosts[h].chip_indices}
         view.available = self.available & node_coords
         view.unhealthy = set(self.unhealthy)
+        view.bad_links = set(self.bad_links)
         view.local_index = dict(self.local_index)
         view.used_millichips = dict(self.used_millichips)
         return view
@@ -521,7 +525,8 @@ class GangAllocator:
                     break
             if len(order) != total or chunks_formed != req.num_pods:
                 continue
-            loc = evaluate_order(st.topo, order, axes, req.axis_weights)
+            loc = evaluate_order(st.topo, order, axes, req.axis_weights,
+                                 st.bad_links)
             pl = Placement(origin=min(order), shape=(0, 0, 0),
                            coords=tuple(order))
             frag = fragmentation_score(st.topo, blocked, pl)
@@ -545,7 +550,8 @@ class GangAllocator:
             return None
         best_order, best_loc = None, -1.0
         for o in orders:
-            loc = evaluate_order(st.topo, o, axes, req.axis_weights)
+            loc = evaluate_order(st.topo, o, axes, req.axis_weights,
+                                 st.bad_links)
             if loc > best_loc:
                 best_order, best_loc = o, loc
         frag = fragmentation_score(st.topo, blocked, pl)

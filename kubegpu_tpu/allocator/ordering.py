@@ -34,15 +34,22 @@ def evaluate_order(
     order: list[Coord],
     axes: dict[str, int],
     axis_weights: dict[str, float] | None = None,
+    bad_links: set[tuple[Coord, Coord]] | None = None,
 ) -> float:
-    """Weighted ICI locality of a candidate logical order."""
+    """Weighted ICI locality of a candidate logical order.
+
+    ``bad_links`` (failed ICI links) force the slow Python path — faults
+    are rare, and correctness of avoiding a dead link beats the native
+    fast path's speed.
+    """
     from kubegpu_tpu.allocator import _native
 
-    native = _native.eval_order_native(topo, order, axes, axis_weights)
-    if native is not None:
-        return native
+    if not bad_links:
+        native = _native.eval_order_native(topo, order, axes, axis_weights)
+        if native is not None:
+            return native
     tm = traffic_pairs_for_mesh_axes(order, axes, axis_weights)
-    return ici_locality(topo, tm)
+    return ici_locality(topo, tm, bad_links)
 
 
 def _grid_orders(placement: Placement) -> list[list[Coord]]:

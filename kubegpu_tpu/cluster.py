@@ -26,6 +26,7 @@ from kubegpu_tpu.kubemeta import (
 from kubegpu_tpu.kubemeta.codec import set_pod_gang, set_pod_mesh_axes
 from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
 from kubegpu_tpu.scheduler import DeviceScheduler
+from kubegpu_tpu.scheduler.health import FaultRecoveryController
 from kubegpu_tpu.tpuplugin import mock_cluster
 
 _port_counter = itertools.count(0)
@@ -76,6 +77,8 @@ class SimCluster:
         self.scheduler = DeviceScheduler(
             self.api, metrics=self.metrics, trace=self.trace,
             coordinator_port=pick_coordinator_port())
+        self.recovery = FaultRecoveryController(
+            self.api, self.scheduler, metrics=self.metrics, trace=self.trace)
         self._unsub = self.api.watch(self._on_event)
 
     # -- lifecycle events: free resources when pods finish/disappear -----
@@ -96,12 +99,87 @@ class SimCluster:
             self.api.create("Pod", p)
 
     def step(self):
-        """One control-plane tick: schedule pending, start bound pods."""
+        """One control-plane tick: recover from faults, schedule pending,
+        start bound pods."""
+        self.recovery.run_once()
         result = self.scheduler.run_once()
         started = []
         for a in self.agents:
             started.extend(a.run_once())
         return result, started
+
+    # -- fault injection (SURVEY.md §6: kill a host mid-gang, flap a
+    #    link/chip — drives the elastic-recovery tests) ------------------
+
+    def agent_for(self, node_name: str) -> NodeAgent:
+        for a in self.agents:
+            if a.node_name == node_name:
+                return a
+        raise KeyError(f"no agent for node {node_name}")
+
+    def fail_host(self, node_name: str) -> None:
+        """Machine death: containers die, node goes NotReady."""
+        self.agent_for(node_name).fail()
+        self.api.set_node_ready(node_name, False)
+
+    def restore_host(self, node_name: str) -> None:
+        self.agent_for(node_name).restore()
+        self.api.set_node_ready(node_name, True)
+
+    def fail_chip(self, node_name: str, local_index: int) -> None:
+        a = self.agent_for(node_name)
+        a.backend.fail_chip(local_index)
+        a.advertise()
+
+    def heal_chip(self, node_name: str, local_index: int) -> None:
+        a = self.agent_for(node_name)
+        a.backend.heal_chip(local_index)
+        a.advertise()
+
+    def fail_link(self, coord_a, coord_b, slice_id: str | None = None) -> None:
+        """Flap an ICI link: every live agent owning an endpoint advertises
+        the failure (both sides of a cross-host link report it).  Coords are
+        slice-local, so with multiple slices of the same shape the link is
+        ambiguous — ``slice_id`` is required then."""
+        candidates = []
+        for a in self.agents:
+            if slice_id is not None and a.backend.slice_id != slice_id:
+                continue
+            topo = a.backend.topo
+            if (topo.has_coord(tuple(coord_a))
+                    and topo.has_coord(tuple(coord_b))):
+                candidates.append(a)
+        owning_slices = {a.backend.slice_id for a in candidates}
+        if len(owning_slices) > 1:
+            raise ValueError(
+                f"link {coord_a}–{coord_b} exists in slices "
+                f"{sorted(owning_slices)}; pass slice_id")
+        owned = False
+        for a in candidates:
+            if not a.down and a.backend.fail_link(coord_a, coord_b):
+                a.advertise()
+                owned = True
+        if not owned:
+            raise ValueError(f"no live agent owns link {coord_a}–{coord_b}")
+
+    def heal_link(self, coord_a, coord_b, slice_id: str | None = None) -> None:
+        pair = (min(tuple(coord_a), tuple(coord_b)),
+                max(tuple(coord_a), tuple(coord_b)))
+        owners = [a for a in self.agents
+                  if (slice_id is None or a.backend.slice_id == slice_id)
+                  and pair in a.backend.bad_links]
+        owning_slices = {a.backend.slice_id for a in owners}
+        if len(owning_slices) > 1:  # symmetric with fail_link's ambiguity rule
+            raise ValueError(
+                f"link {coord_a}–{coord_b} is bad in slices "
+                f"{sorted(owning_slices)}; pass slice_id")
+        if not owners:
+            raise ValueError(
+                f"link {coord_a}–{coord_b} was not marked bad on any agent")
+        for a in owners:
+            a.backend.heal_link(coord_a, coord_b)
+            if not a.down:
+                a.advertise()
 
     def reap(self, timeout: float | None = None) -> dict[str, int]:
         codes: dict[str, int] = {}
@@ -137,6 +215,7 @@ class SimCluster:
 
     def close(self) -> None:
         self._unsub()
+        self.recovery.close()
         for a in self.agents:
             for h in a.handles.values():
                 h.kill()

@@ -33,6 +33,9 @@ class NodeAgent:
         self.runtime = runtime
         self.shim = CriShim(api, backend, self.node_name, runtime)
         self.handles: dict[str, ContainerHandle] = {}  # pod name → handle
+        self._uids: dict[str, str] = {}  # pod name → uid of the incarnation
+        self._ns: dict[str, str] = {}    # pod name → namespace
+        self.down = False  # host failure: agent stops heartbeating/acting
 
     # -- advertisement (SURVEY.md §4.1) ---------------------------------
 
@@ -54,8 +57,50 @@ class NodeAgent:
 
     # -- pod lifecycle (SURVEY.md §4.3) ---------------------------------
 
+    # -- failure injection (simulated machine death) --------------------
+
+    def fail(self) -> None:
+        """The host dies: containers are gone, the agent stops acting.
+        (The node controller flips Node.ready separately, as in k8s.)"""
+        self.down = True
+        for h in self.handles.values():
+            h.kill()
+        self.handles.clear()
+        self._uids.clear()
+        self._ns.clear()
+
+    def restore(self) -> None:
+        """Host comes back: re-register + re-advertise fresh health."""
+        self.down = False
+        self.register()
+
+    # -- reconcile ------------------------------------------------------
+
+    def reconcile(self) -> None:
+        """Kill containers whose pod was deleted/evicted (kubelet's
+        pod-worker teardown when the apiserver drops a pod it runs).
+        Incarnations are matched by uid, not name: an evicted gang member
+        recreated with the same name and re-bound to this node is a NEW
+        pod — the old container (stale chip set/coordinator env) must die
+        or the recovered gang can never form its jax.distributed barrier."""
+        for pod_name in list(self.handles):
+            try:
+                pod = self.api.get("Pod", pod_name,
+                                   namespace=self._ns.get(pod_name, "default"))
+                gone = (pod.spec.node_name != self.node_name
+                        or pod.metadata.uid != self._uids.get(pod_name))
+            except NotFound:
+                gone = True
+            if gone:
+                self.handles.pop(pod_name).kill()
+                self._uids.pop(pod_name, None)
+                self._ns.pop(pod_name, None)
+
     def run_once(self) -> list[ContainerHandle]:
         """Start containers for pods newly bound to this node."""
+        if self.down:
+            return []
+        self.reconcile()
         started: list[ContainerHandle] = []
         for pod in self.api.list("Pod"):
             if (pod.spec.node_name == self.node_name
@@ -63,6 +108,8 @@ class NodeAgent:
                     and pod.name not in self.handles):
                 handle = self.shim.create_container(pod)
                 self.handles[pod.name] = handle
+                self._uids[pod.name] = pod.metadata.uid
+                self._ns[pod.name] = pod.metadata.namespace
                 self.api.set_pod_phase(pod.name, PodPhase.RUNNING,
                                        namespace=pod.metadata.namespace)
                 started.append(handle)
@@ -72,17 +119,26 @@ class NodeAgent:
         """Wait for running containers; report exit codes and update pod
         phases (Succeeded/Failed)."""
         results: dict[str, int] = {}
+        if self.down:
+            return results
         for pod_name, handle in list(self.handles.items()):
             code = handle.wait(timeout=timeout)
             if code is None:
                 continue
             results[pod_name] = code
             phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
+            ns = self._ns.get(pod_name, "default")
             try:
-                self.api.set_pod_phase(pod_name, phase,
-                                       message=handle.stderr[-2000:] if code else "",
-                                       exit_code=code)
+                pod = self.api.get("Pod", pod_name, namespace=ns)
+                # only report for the incarnation this container belongs to
+                if pod.metadata.uid == self._uids.get(pod_name):
+                    self.api.set_pod_phase(
+                        pod_name, phase,
+                        message=handle.stderr[-2000:] if code else "",
+                        exit_code=code, namespace=ns)
             except NotFound:
                 pass
             del self.handles[pod_name]
+            self._uids.pop(pod_name, None)
+            self._ns.pop(pod_name, None)
         return results

@@ -39,6 +39,7 @@ def node_advertisement_to_annotation(adv: NodeAdvertisement) -> str:
         "wrap": list(adv.wrap),
         "hostBlock": list(adv.host_block),
         "internalIp": adv.internal_ip,
+        "badLinks": [[list(a), list(b)] for a, b in adv.bad_links],
         "chips": [
             {
                 "coord": list(c.coord),
@@ -63,6 +64,8 @@ def node_advertisement_from_annotation(payload: str) -> NodeAdvertisement:
         wrap=tuple(bool(w) for w in d["wrap"]),
         host_block=tuple(d["hostBlock"]),
         internal_ip=d.get("internalIp", "127.0.0.1"),
+        bad_links=tuple(
+            (tuple(a), tuple(b)) for a, b in d.get("badLinks", [])),
         chips=tuple(
             ChipAdvertisement(
                 coord=tuple(c["coord"]),

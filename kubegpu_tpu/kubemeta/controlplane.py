@@ -160,6 +160,22 @@ class FakeApiServer:
             self._bump(pod)
             self._notify(WatchEvent("Pod", "MODIFIED", copy.deepcopy(pod)))
 
+    def set_node_ready(self, name: str, ready: bool,
+                       namespace: str = "default") -> None:
+        """Node-lifecycle verb (node controller marking NotReady on missed
+        heartbeats — the k8s-native failure detection SURVEY.md §6 says the
+        reference relied on)."""
+        with self._lock:
+            key = self._key(namespace, name)
+            node = self._stores["Node"].objects.get(key)
+            if node is None:
+                raise NotFound(f"Node {key}")
+            if node.status.ready == ready:
+                return
+            node.status.ready = ready
+            self._bump(node)
+            self._notify(WatchEvent("Node", "MODIFIED", copy.deepcopy(node)))
+
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         with self._lock:
             store = self._stores[kind]

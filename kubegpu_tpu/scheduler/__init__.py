@@ -8,5 +8,10 @@ cluster state is rebuilt from annotations on restart (§4.4 subtlety).
 """
 
 from kubegpu_tpu.scheduler.extender import DeviceScheduler, ScheduleResult
+from kubegpu_tpu.scheduler.health import (
+    FaultRecoveryController,
+    RecoveryResult,
+)
 
-__all__ = ["DeviceScheduler", "ScheduleResult"]
+__all__ = ["DeviceScheduler", "ScheduleResult", "FaultRecoveryController",
+           "RecoveryResult"]

@@ -99,22 +99,26 @@ class DeviceScheduler:
             if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
                 continue
             alloc = pod_allocation(pod)
-            if alloc is None or alloc.slice_id not in self.slices:
+            if alloc is None:
                 continue
-            self.slices[alloc.slice_id].take(alloc.chips)
+            if alloc.slice_id in self.slices:
+                self.slices[alloc.slice_id].take(alloc.chips)
             gang = alloc.gang_name or pod.name
             self._pod_gang[pod.name] = gang
             gang_pods.setdefault(gang, []).append(alloc)
         # Rebuild committed assignments from annotation truth so later
         # completions release chips even across scheduler restarts/re-syncs.
+        # Gangs whose slice vanished (all hosts down) are kept too — the
+        # recovery controller must still see them to evict/requeue, else
+        # they'd zombie as RUNNING pods bound to dead nodes.
         for gang, allocs in gang_pods.items():
-            st = self.slices[allocs[0].slice_id]
+            st = self.slices.get(allocs[0].slice_id)
             pods = [
                 PodAssignment(
                     pod_index=a.worker_id,
                     node_name=a.node_name,
                     host_id=st.topo.chip_at(a.chips[0].coord).host_id
-                    if a.chips else 0,
+                    if st is not None and a.chips else 0,
                     chips=list(a.chips))
                 for a in sorted(allocs, key=lambda a: a.worker_id)
             ]

@@ -1,0 +1,203 @@
+"""Failure detection + elastic recovery (SURVEY.md §6).
+
+The reference had no first-party failure handling — it leaned on
+Kubernetes-native behavior (node NotReady eviction, pod restart policies)
+and on its annotations-as-truth design making the scheduler restartable.
+This controller is the TPU-native equivalent SURVEY.md §6 specifies: a
+chip/link marked bad (or a host going NotReady) makes the slices containing
+it infeasible, and any *committed gang* touching the fault is evicted and
+requeued so the scheduler re-places it on a fresh healthy sub-mesh.
+
+Gang semantics drive the whole-gang eviction: a JAX multi-host program is
+all-or-nothing (``jax.distributed`` workers must restart together to form a
+new coordination barrier), so losing one worker's chips means evicting every
+member — partial recovery is impossible by construction.
+
+Eviction here collapses two k8s actors into one step, the same way the rest
+of the simulated control plane does: the *eviction* (delete) and the *Job /
+StatefulSet controller* recreating an identical pending pod.  The recreated
+pod keeps its name, spec, gang membership, and mesh-axes hint; it loses its
+binding and allocation annotation, so the next scheduling pass treats the
+gang as brand new.  Workload-side resume is the checkpoint story
+(workloads/ Orbax-style checkpointing; see tests/test_recovery.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubegpu_tpu.allocator.gang import GangAssignment, SliceState
+from kubegpu_tpu.kubemeta import FakeApiServer, NotFound, Pod, PodPhase
+from kubegpu_tpu.kubemeta.codec import ALLOCATE_FROM_KEY, GANG_KEY
+from kubegpu_tpu.kubemeta.controlplane import WatchEvent
+from kubegpu_tpu.kubemeta.objects import ObjectMeta, PodStatus
+from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
+from kubegpu_tpu.scheduler.extender import DeviceScheduler
+
+
+@dataclass
+class RecoveryResult:
+    evicted_gangs: dict[str, str] = field(default_factory=dict)  # gang → why
+    requeued_pods: list[str] = field(default_factory=list)
+
+
+class FaultRecoveryController:
+    """Watches Node health, detects broken committed gangs, evicts+requeues.
+
+    Runs as part of the control-plane tick (SimCluster.step), before the
+    scheduling pass, so a fault observed at tick T has its gangs back in the
+    queue for the same tick's scheduling decision.
+    """
+
+    def __init__(self, api: FakeApiServer, scheduler: DeviceScheduler,
+                 metrics: MetricsRegistry | None = None,
+                 trace: ScheduleTrace | None = None):
+        self.api = api
+        self.scheduler = scheduler
+        self.metrics = metrics or scheduler.metrics
+        self.trace = trace or scheduler.trace
+        self._dirty = True  # first pass always inspects
+        self._degraded: set[str] = set()  # gangs left on a bad link
+        self._unsub = api.watch(self._on_event)
+
+    def close(self) -> None:
+        self._unsub()
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        # Any node change (readiness flip, re-advertisement after a fault
+        # injection, node add/remove) can change slice health.  Pod churn
+        # matters only while a degraded gang waits for capacity to free up
+        # (a completing pod may open the better footprint it needs).
+        if ev.kind == "Node" or (ev.kind == "Pod" and self._degraded):
+            self._dirty = True
+
+    # ------------------------------------------------------------------
+
+    def run_once(self) -> RecoveryResult:
+        result = RecoveryResult()
+        if not self._dirty:
+            return result
+        self._dirty = False
+        # Re-sync slice states from annotation truth: not-ready nodes drop
+        # out (their coords leave `available`), re-advertised health lands
+        # in `unhealthy`/`bad_links`.
+        self.scheduler.sync()
+        self._degraded.clear()
+        for gang, asg in list(self.scheduler._committed.items()):
+            broken = self._broken_reason(asg)
+            if broken is None:
+                continue
+            reason, kind = broken
+            if kind == "link" and not self._better_placement_exists(gang, asg):
+                # The dead link degrades this gang, but every alternative is
+                # the same footprint (or nothing) — evicting would thrash.
+                # Tracked so pod churn re-triggers this evaluation.
+                self._degraded.add(gang)
+                self.trace.record("degraded", gang=gang,
+                                  detail={"reason": reason})
+                continue
+            self._evict_gang(gang, asg, reason, result)
+        if result.evicted_gangs:
+            # Eviction released chips; the queue sees the pods next pass.
+            self.metrics.inc("gangs_evicted", len(result.evicted_gangs))
+        return result
+
+    # ------------------------------------------------------------------
+
+    def _broken_reason(self, asg: GangAssignment) -> tuple[str, str] | None:
+        """(human reason, kind) — kind 'hard' (chips gone) or 'link'
+        (degraded: chips fine, an interior ICI link died)."""
+        st = self.scheduler.slices.get(asg.slice_id)
+        if st is None:
+            return "slice disappeared (all hosts down)", "hard"
+        coords = [ch.coord for p in asg.pods for ch in p.chips]
+        coord_set = set(coords)
+        for c in coords:
+            if c not in st.available:
+                return f"chip {c} no longer advertised (host down)", "hard"
+            if c in st.unhealthy:
+                return f"chip {c} marked unhealthy", "hard"
+        # A dead ICI link strictly inside the allocation footprint breaks
+        # the gang's collectives (rings detour → catastrophic slowdown on
+        # a torus) — re-place if anywhere better exists.
+        for a, b in st.bad_links:
+            if a in coord_set and b in coord_set:
+                return f"ICI link {a}–{b} failed inside allocation", "link"
+        return None
+
+    def _better_placement_exists(self, gang: str,
+                                 asg: GangAssignment) -> bool:
+        """Trial re-placement with this gang's chips freed: is there an
+        assignment on a different footprint?  (Scoring already penalizes
+        bad links, so a different footprint means a better one.)"""
+        members = []
+        for name, g in self.scheduler._pod_gang.items():
+            if g == gang:
+                try:
+                    members.append(self.api.get("Pod", name))
+                except NotFound:
+                    return False
+        if not members:
+            return False
+        try:
+            if len(members) == 1 and not members[0].metadata.annotations.get(
+                    GANG_KEY):
+                req = self.scheduler._request_for_single(members[0])
+            else:
+                members.sort(key=lambda p: p.name)
+                req = self.scheduler._request_for_gang(gang, members)
+        except ValueError:
+            return False
+        alloc = self.scheduler.allocator
+        slices = self.scheduler.slices
+        alloc.rollback(slices, asg)
+        try:
+            alt = alloc.find_assignment(list(slices.values()), req)
+        finally:
+            alloc.commit(slices, asg)
+        if alt is None:
+            return False
+        cur = {ch.coord for p in asg.pods for ch in p.chips}
+        new = {ch.coord for p in alt.pods for ch in p.chips}
+        return (alt.slice_id, new) != (asg.slice_id, cur)
+
+    def _evict_gang(self, gang: str, asg: GangAssignment, reason: str,
+                    result: RecoveryResult) -> None:
+        members = [p for p, g in self.scheduler._pod_gang.items() if g == gang]
+        self.trace.record("evict", gang=gang, detail={
+            "reason": reason, "pods": sorted(members)})
+        pods: list[Pod] = []
+        for name in members:
+            try:
+                pods.append(self.api.get("Pod", name))
+            except NotFound:
+                pass
+        # Delete first (kills containers via node-agent reconcile, frees the
+        # allocation via the scheduler's return-resources path), then
+        # recreate pending replacements.
+        for pod in pods:
+            try:
+                self.api.delete("Pod", pod.name,
+                                namespace=pod.metadata.namespace)
+            except NotFound:
+                pass
+            # Belt-and-braces: free chips even when no lifecycle wiring
+            # (e.g. controller used standalone in tests) — idempotent, the
+            # scheduler pops the pod from its gang map on first call.
+            self.scheduler.return_pod_resources(pod.name)
+        for pod in pods:
+            annotations = {k: v for k, v in pod.metadata.annotations.items()
+                           if k != ALLOCATE_FROM_KEY}
+            fresh = Pod(
+                metadata=ObjectMeta(
+                    name=pod.metadata.name,
+                    namespace=pod.metadata.namespace,
+                    labels=dict(pod.metadata.labels),
+                    annotations=annotations),
+                spec=pod.spec,
+                status=PodStatus(phase=PodPhase.PENDING,
+                                 message=f"requeued: {reason}"))
+            fresh.spec.node_name = None
+            self.api.create("Pod", fresh)
+            result.requeued_pods.append(fresh.name)
+        result.evicted_gangs[gang] = reason

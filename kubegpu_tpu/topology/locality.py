@@ -100,13 +100,16 @@ def traffic_pairs_for_mesh_axes(
     return tm
 
 
-def ici_locality(topo: TpuTopology, tm: TrafficModel) -> float:
+def ici_locality(topo: TpuTopology, tm: TrafficModel,
+                 bad_links: set[tuple[Coord, Coord]] | None = None) -> float:
     """Weighted fraction of traffic pairs that are single-hop ICI links.
 
     1.0 = every collective neighbor exchange rides a direct ICI link;
     the north-star demands ≥0.90 for the Llama-3-8B pjit gang on v5e-64
     (BASELINE.md).  Pairs between chips on different meshes (no coord in
-    ``topo``) count as DCN (non-local).
+    ``topo``) count as DCN (non-local).  A pair riding a link in
+    ``bad_links`` (normalized (min,max) coord pairs) is non-local: traffic
+    must detour around the dead link.
     """
     if not tm.pairs:
         return 1.0
@@ -114,7 +117,9 @@ def ici_locality(topo: TpuTopology, tm: TrafficModel) -> float:
     local = 0.0
     for (a, b), w in tm.pairs.items():
         total += w
-        if topo.has_coord(a) and topo.has_coord(b) and topo.are_ici_adjacent(a, b):
+        if (topo.has_coord(a) and topo.has_coord(b)
+                and topo.are_ici_adjacent(a, b)
+                and not (bad_links and (min(a, b), max(a, b)) in bad_links)):
             local += w
     return local / total
 

@@ -50,6 +50,12 @@ class NodeAdvertisement:
     host_block: Coord
     chips: tuple[ChipAdvertisement, ...] = field(default_factory=tuple)
     internal_ip: str = "127.0.0.1"
+    # Failed ICI links incident to this host's chips, as normalized
+    # (min(a,b), max(a,b)) coord pairs.  Both endpoints' hosts advertise a
+    # shared link; the scheduler unions them per slice (SURVEY.md §6
+    # failure-detection row: a bad link makes ring placements across it
+    # score low and marks gangs straddling it for recovery).
+    bad_links: tuple[tuple[Coord, Coord], ...] = field(default_factory=tuple)
 
     @property
     def num_chips(self) -> int:

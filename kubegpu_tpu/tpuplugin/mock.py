@@ -17,7 +17,13 @@ from kubegpu_tpu.tpuplugin.backend import (
 
 
 class MockBackend(DeviceBackend):
-    """Pretends to be host ``host_id`` of a ``slice_type`` slice."""
+    """Pretends to be host ``host_id`` of a ``slice_type`` slice.
+
+    Carries mutable fault state (bad chips / bad incident ICI links) so
+    tests and the SimCluster can inject faults mid-run and the advertiser
+    re-enumeration picks them up — the fault-injection hooks SURVEY.md §6
+    calls for (kill a chip, flap a link) driving recovery tests.
+    """
 
     def __init__(self, slice_type: str, host_id: int = 0,
                  slice_id: str | None = None, node_name: str | None = None,
@@ -33,10 +39,41 @@ class MockBackend(DeviceBackend):
         self.host_id = host_id
         self.slice_id = slice_id or f"{slice_type}-slice-0"
         self.node_name = node_name or f"{self.slice_id}-host-{host_id}"
-        self.unhealthy_chips = unhealthy_chips or set()
+        self.unhealthy_chips: set[int] = set(unhealthy_chips or set())
+        self.bad_links: set[tuple] = set()   # normalized coord pairs
+        self.topo = TpuTopology.build(self.spec)
+
+    # -- fault injection (mutable health state) -------------------------
+
+    def _local_coords(self) -> set:
+        host = self.topo.hosts[self.host_id]
+        return {self.topo.chips[i].coord for i in host.chip_indices}
+
+    def fail_chip(self, local_index: int) -> None:
+        if not 0 <= local_index < self.spec.chips_per_host:
+            raise ValueError(f"no local chip {local_index}")
+        self.unhealthy_chips.add(local_index)
+
+    def heal_chip(self, local_index: int) -> None:
+        self.unhealthy_chips.discard(local_index)
+
+    def fail_link(self, a, b) -> bool:
+        """Mark the ICI link a↔b bad if one endpoint is local; returns
+        whether this host owns (and therefore advertises) the link."""
+        a, b = tuple(a), tuple(b)
+        if not self.topo.are_ici_adjacent(a, b):
+            raise ValueError(f"{a}–{b} is not an ICI link")
+        if not ({a, b} & self._local_coords()):
+            return False
+        self.bad_links.add((min(a, b), max(a, b)))
+        return True
+
+    def heal_link(self, a, b) -> None:
+        a, b = tuple(a), tuple(b)
+        self.bad_links.discard((min(a, b), max(a, b)))
 
     def discover(self) -> NodeAdvertisement:
-        topo = TpuTopology.build(self.spec)
+        topo = self.topo
         host = topo.hosts[self.host_id]
         chips = tuple(
             ChipAdvertisement(
@@ -57,6 +94,7 @@ class MockBackend(DeviceBackend):
             wrap=self.spec.wrap,
             host_block=self.spec.host_block,
             chips=chips,
+            bad_links=tuple(sorted(self.bad_links)),
         )
 
     def allocate_env(self, chips, worker_id, num_workers,

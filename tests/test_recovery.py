@@ -1,0 +1,314 @@
+"""Fault detection + elastic recovery (SURVEY.md §6): kill a host
+mid-gang, fail a chip, flap a link — the gang gets evicted and
+rescheduled onto a fresh healthy sub-mesh; freed chips are reusable;
+state survives scheduler restarts (annotation truth)."""
+
+import random
+
+import pytest
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, PodPhase
+from kubegpu_tpu.kubemeta.codec import pod_allocation
+from kubegpu_tpu.scheduler import DeviceScheduler, FaultRecoveryController
+from kubegpu_tpu.tpuplugin.backend import MILLICHIPS_PER_CHIP
+
+
+def submit_gang(cl, name, size, chips, axes=None):
+    pods = []
+    for i in range(size):
+        pods.append(tpu_pod(f"{name}-{i}", chips=chips,
+                            gang=GangSpec(name=name, size=size, index=i),
+                            mesh_axes=axes, command=["noop"]))
+    cl.submit(*pods)
+    return [p.name for p in pods]
+
+
+def allocated_coords(cl, names):
+    out = {}
+    for n in names:
+        alloc = pod_allocation(cl.api.get("Pod", n))
+        out[n] = [ch.coord for ch in alloc.chips] if alloc else None
+    return out
+
+
+class TestChipFailure:
+    def test_failed_chip_evicts_and_reschedules_gang(self):
+        cl = SimCluster(["v5e-16"])
+        names = submit_gang(cl, "job", size=4, chips=2,
+                            axes={"dp": 4, "tp": 2})
+        result, started = cl.step()
+        assert len(result.scheduled) == 4
+        before = allocated_coords(cl, names)
+        # fail one allocated chip on its node
+        victim = cl.api.get("Pod", names[0])
+        alloc = pod_allocation(victim)
+        cl.fail_chip(alloc.node_name, alloc.chips[0].local_index)
+        result, _ = cl.step()
+        # gang was evicted and immediately rescheduled avoiding the chip
+        after = allocated_coords(cl, names)
+        assert all(v is not None for v in after.values())
+        bad = alloc.chips[0].coord
+        all_after = [c for chips in after.values() for c in chips]
+        assert bad not in all_after
+        assert sorted(all_after) != sorted(
+            c for chips in before.values() for c in chips)
+        # worker ids preserved (gang index order)
+        for i, n in enumerate(names):
+            assert pod_allocation(cl.api.get("Pod", n)).worker_id == i
+        assert cl.metrics.counter("gangs_evicted") == 1
+        cl.close()
+
+    def test_same_node_replacement_restarts_container_fresh_env(self):
+        """Regression: an evicted gang member re-bound to the SAME node
+        must get a NEW container with the new allocation env — the old
+        incarnation's container (stale chip set/coordinator) must die."""
+        cl = SimCluster(["v5e-16"])
+        names = submit_gang(cl, "job", size=4, chips=2,
+                            axes={"dp": 4, "tp": 2})
+        _, started1 = cl.step()
+        envs1 = {h.pod_name: h.env for h in started1}
+        victim = pod_allocation(cl.api.get("Pod", names[0]))
+        cl.fail_chip(victim.node_name, victim.chips[0].local_index)
+        _, started2 = cl.step()
+        # every member restarted (all four gang workers), even ones whose
+        # re-placement landed on the same node under the same name
+        assert {h.pod_name for h in started2} == set(names)
+        for h in started2:
+            assert h.env["TPU_VISIBLE_CHIPS"] != ""
+        # all pods progressed to RUNNING with the new incarnation
+        for n in names:
+            assert cl.pod_phase(n) == PodPhase.RUNNING
+            alloc = pod_allocation(cl.api.get("Pod", n))
+            agent = cl.agent_for(alloc.node_name)
+            assert n in agent.handles
+            new_chips = ",".join(str(c.local_index) for c in alloc.chips)
+            assert agent.handles[n].env["TPU_VISIBLE_CHIPS"] == new_chips
+        # old incarnation's env differed for at least the victim pod
+        new_envs = {h.pod_name: h.env for h in started2}
+        assert (new_envs[names[0]]["TPU_VISIBLE_CHIPS"]
+                != envs1[names[0]]["TPU_VISIBLE_CHIPS"]
+                or [c.coord for c in pod_allocation(
+                    cl.api.get("Pod", names[0])).chips]
+                != [c.coord for c in victim.chips])
+        cl.close()
+
+    def test_healed_chip_usable_again(self):
+        cl = SimCluster(["v4-8"])
+        node = cl.agents[0].node_name
+        cl.fail_chip(node, 0)
+        cl.submit(tpu_pod("big", chips=4, command=["noop"]))
+        result, _ = cl.step()
+        assert result.unschedulable == ["big"]
+        cl.heal_chip(node, 0)
+        result, _ = cl.step()
+        assert result.scheduled == ["big"]
+        cl.close()
+
+
+class TestHostFailure:
+    def test_host_death_reschedules_gang_to_other_slice(self):
+        """Kill a host mid-gang (SURVEY.md §6): the whole gang restarts on
+        healthy hardware — including members whose own host survived."""
+        cl = SimCluster(["v5e-16", "v5e-16"])
+        names = submit_gang(cl, "job", size=4, chips=4,
+                            axes={"dp": 4, "tp": 4})
+        result, started = cl.step()
+        assert len(result.scheduled) == 4
+        slice_before = pod_allocation(cl.api.get("Pod", names[0])).slice_id
+        victim_node = pod_allocation(cl.api.get("Pod", names[0])).node_name
+        cl.fail_host(victim_node)
+        result, started = cl.step()
+        after = allocated_coords(cl, names)
+        assert all(v is not None for v in after.values())
+        new_nodes = {pod_allocation(cl.api.get("Pod", n)).node_name
+                     for n in names}
+        assert victim_node not in new_nodes
+        # v5e-16 minus one host can't fit 16 chips → other slice hosts it
+        assert pod_allocation(
+            cl.api.get("Pod", names[0])).slice_id != slice_before
+        # fresh containers started for the restarted gang
+        assert {h.pod_name for h in started} == set(names)
+        cl.close()
+
+    def test_whole_slice_death_still_evicts_gang(self):
+        """Regression: a gang whose ENTIRE slice vanishes (single-host
+        v4-8 dies) must still be seen by the recovery controller — sync()
+        must not silently drop committed gangs with a missing slice,
+        leaving zombie RUNNING pods bound to a dead node."""
+        cl = SimCluster(["v4-8", "v5e-16"])
+        names = submit_gang(cl, "job", size=4, chips=1)
+        cl.step()
+        sid = pod_allocation(cl.api.get("Pod", names[0])).slice_id
+        assert sid.startswith("v4-8")
+        cl.fail_host(pod_allocation(cl.api.get("Pod", names[0])).node_name)
+        cl.step()
+        assert cl.metrics.counter("gangs_evicted") == 1
+        after = allocated_coords(cl, names)
+        assert all(v is not None for v in after.values())
+        assert pod_allocation(
+            cl.api.get("Pod", names[0])).slice_id.startswith("v5e-16")
+        cl.close()
+
+    def test_single_slice_gang_pends_until_host_restored(self):
+        cl = SimCluster(["v5e-16"])
+        names = submit_gang(cl, "job", size=4, chips=4)
+        cl.step()
+        victim = pod_allocation(cl.api.get("Pod", names[0])).node_name
+        cl.fail_host(victim)
+        result, _ = cl.step()
+        # 12 healthy chips < 16 asked: gang pends, does not half-place
+        assert set(result.unschedulable) == set(names)
+        assert all(cl.pod_phase(n) == PodPhase.PENDING for n in names)
+        cl.restore_host(victim)
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4
+        cl.close()
+
+    def test_dead_host_containers_killed_on_survivors(self):
+        """Members on healthy hosts get torn down when the gang restarts
+        (kubelet reconcile of deleted pods)."""
+        cl = SimCluster(["v5e-16", "v5e-16"])
+        names = submit_gang(cl, "job", size=4, chips=4)
+        cl.step()
+        nodes = {n: pod_allocation(cl.api.get("Pod", n)).node_name
+                 for n in names}
+        victim_node = nodes[names[0]]
+        survivor_agents = {cl.agent_for(nd) for n, nd in nodes.items()
+                           if nd != victim_node}
+        assert any(a.handles for a in survivor_agents)
+        cl.fail_host(victim_node)
+        cl.step()
+        for a in survivor_agents:
+            for n in names:
+                assert n not in a.handles or \
+                    pod_allocation(cl.api.get("Pod", n)).node_name == a.node_name
+        cl.close()
+
+
+class TestLinkFailure:
+    def test_new_allocations_avoid_bad_link(self):
+        """A tp ring placed after a link flap must not ride the dead link
+        as a collective hop."""
+        cl = SimCluster(["v5e-16"])
+        sid = cl.agents[0].backend.slice_id
+        cl.fail_link((0, 0, 0), (1, 0, 0), slice_id=sid)
+        names = submit_gang(cl, "job", size=2, chips=4,
+                            axes={"tp": 8})
+        result, _ = cl.step()
+        assert len(result.scheduled) == 2
+        # every consecutive tp-ring pair must avoid the dead link
+        coords = []
+        for n in names:
+            coords.extend(pod_allocation(cl.api.get("Pod", n)).chips)
+        order = [c.coord for c in coords]
+        bad = ((0, 0, 0), (1, 0, 0))
+        for i in range(len(order)):
+            a, b = order[i], order[(i + 1) % len(order)]
+            assert (min(a, b), max(a, b)) != bad
+        cl.close()
+
+    def test_link_failure_inside_allocation_triggers_recovery(self):
+        cl = SimCluster(["v5e-16", "v5e-16"])
+        names = submit_gang(cl, "job", size=4, chips=4,
+                            axes={"dp": 4, "tp": 4})
+        cl.step()
+        before = allocated_coords(cl, names)
+        chips = sorted({c for v in before.values() for c in v})
+        # find an ICI link strictly inside the allocation
+        topo = cl.scheduler.slices[
+            pod_allocation(cl.api.get("Pod", names[0])).slice_id].topo
+        link = None
+        for a in chips:
+            for b in chips:
+                if a < b and topo.are_ici_adjacent(a, b):
+                    link = (a, b)
+                    break
+            if link:
+                break
+        assert link is not None
+        sid = pod_allocation(cl.api.get("Pod", names[0])).slice_id
+        cl.fail_link(*link, slice_id=sid)
+        cl.step()
+        assert cl.metrics.counter("gangs_evicted") == 1
+        after = allocated_coords(cl, names)
+        assert all(v is not None for v in after.values())
+        # healed link: next gang may use those chips again
+        cl.heal_link(*link, slice_id=sid)
+        cl.step()
+        cl.close()
+
+
+class TestRestartRecovery:
+    def test_fresh_scheduler_detects_fault_from_annotations(self):
+        """Scheduler + recovery controller restart: all state (allocations,
+        gang membership, health) rebuilds from annotations, and a fault
+        injected while 'down' is detected on the first pass after restart."""
+        cl = SimCluster(["v5e-16", "v4-8"])
+        names = submit_gang(cl, "job", size=4, chips=2)
+        cl.step()
+        victim = pod_allocation(cl.api.get("Pod", names[0]))
+        # replace scheduler+controller wholesale (process restart)
+        cl.recovery.close()
+        cl.scheduler = DeviceScheduler(
+            cl.api, metrics=cl.metrics, trace=cl.trace,
+            coordinator_port=9900)
+        cl.recovery = FaultRecoveryController(cl.api, cl.scheduler)
+        cl.fail_chip(victim.node_name, victim.chips[0].local_index)
+        cl.step()
+        after = allocated_coords(cl, names)
+        assert all(v is not None for v in after.values())
+        assert victim.chips[0].coord not in [
+            c for v in after.values() for c in v]
+        cl.close()
+
+
+class TestNoDoubleBooking:
+    def test_random_fault_storm_never_overbooks(self):
+        """Property: arbitrary fault/heal/churn sequences keep every chip's
+        occupancy within capacity and committed gangs disjoint."""
+        rng = random.Random(7)
+        cl = SimCluster(["v5e-16", "v4-8"])
+        gang_i = 0
+        live_nodes = [a.node_name for a in cl.agents]
+        down = set()
+        for step in range(40):
+            op = rng.random()
+            if op < 0.4:
+                gang_i += 1
+                submit_gang(cl, f"g{gang_i}", size=rng.choice([1, 2, 4]),
+                            chips=rng.choice([1, 2, 4]))
+            elif op < 0.6 and len(down) < len(live_nodes) - 1:
+                n = rng.choice([x for x in live_nodes if x not in down])
+                down.add(n)
+                cl.fail_host(n)
+            elif op < 0.8 and down:
+                n = rng.choice(sorted(down))
+                down.remove(n)
+                cl.restore_host(n)
+            else:
+                running = [p for p in cl.api.list("Pod")
+                           if p.status.phase != PodPhase.PENDING]
+                if running:
+                    victim = rng.choice(running)
+                    try:
+                        cl.api.delete("Pod", victim.name)
+                    except Exception:
+                        pass
+            cl.step()
+            # invariant: no chip over-allocated
+            for st in cl.scheduler.slices.values():
+                for coord, used in st.used_millichips.items():
+                    assert 0 <= used <= MILLICHIPS_PER_CHIP, \
+                        f"step {step}: chip {coord} at {used}"
+            # invariant: committed gangs' whole-chip sets disjoint
+            seen = {}
+            for gang, asg in cl.scheduler._committed.items():
+                for p in asg.pods:
+                    for ch in p.chips:
+                        if ch.millichips == MILLICHIPS_PER_CHIP:
+                            key = (asg.slice_id, ch.coord)
+                            assert key not in seen, \
+                                f"step {step}: {key} in {gang} and {seen[key]}"
+                            seen[key] = gang
+        cl.close()
