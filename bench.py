@@ -1,119 +1,18 @@
-"""KubeTPU benchmark: gang-schedule p50 latency (north-star metric #1).
+"""KubeTPU benchmark entry point: gang-schedule p50 latency.
 
-Drives the real scheduler end-to-end on a simulated multi-slice cluster
-(2× v5e-64 + v4-8) with a churning stream of mixed gang workloads — the
-same path BASELINE.md's "gang-schedule p50 latency" names.  Prints ONE
-JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-
-``vs_baseline`` compares against the stand-in baseline BASELINE.md defines
-(the reference publishes no numbers): 50 ms p50, the figure recorded from
-this framework's round-1 run.  >1.0 means faster than baseline.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The benchmark itself lives in kubegpu_tpu/benchmark.py (shared with the
+``kubetpu bench`` CLI verb); this file is the driver's stable entry point.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import random
 import sys
-
-BASELINE_P50_MS = 50.0
-
-
-def run_bench(n_gangs: int = 60, seed: int = 0) -> dict:
-    from kubegpu_tpu.cluster import SimCluster, tpu_pod
-    from kubegpu_tpu.kubemeta import GangSpec
-
-    rng = random.Random(seed)
-    cl = SimCluster(["v5e-64", "v5e-64", "v4-8"])
-    # mixed workload: DP gangs, tp-heavy llama-style gangs, single chips,
-    # fractional co-tenants — with completion churn so the allocator works
-    # against fragmentation, not an empty cluster.
-    shapes = [
-        dict(pods=4, chips=1, axes={"dp": 4}),
-        dict(pods=4, chips=4, axes={"dp": 4, "tp": 4}),
-        dict(pods=16, chips=4, axes={"dp": 4, "tp": 16}),
-        dict(pods=8, chips=4, axes={"dp": 2, "tp": 16}),
-        dict(pods=1, chips=1, axes=None),
-        dict(pods=1, chips=4, axes={"dp": 1, "tp": 4}),
-        dict(pods=1, chips=0, axes=None, millitpu=500),
-    ]
-    from kubegpu_tpu.kubemeta import NotFound, PodPhase
-
-    def finish_one(live_list):
-        """Complete one random live gang: delete its pods → watch event →
-        the scheduler releases its slice."""
-        for name in live_list.pop(rng.randrange(len(live_list))):
-            try:
-                cl.api.delete("Pod", name)
-            except NotFound:
-                pass
-
-    def gang_placed(names):
-        return all(
-            cl.api.get("Pod", n).status.phase != PodPhase.PENDING
-            for n in names)
-
-    live: list[list[str]] = []
-    for g in range(n_gangs):
-        spec = rng.choice(shapes)
-        names = []
-        if spec.get("millitpu"):
-            names.append(f"frac-{g}")
-            cl.submit(tpu_pod(f"frac-{g}", millitpu=spec["millitpu"],
-                              command=["x"]))
-        elif spec["pods"] == 1:
-            names.append(f"pod-{g}")
-            cl.submit(tpu_pod(f"pod-{g}", chips=spec["chips"],
-                              mesh_axes=spec["axes"], command=["x"]))
-        else:
-            for i in range(spec["pods"]):
-                name = f"gang{g}-{i}"
-                names.append(name)
-                cl.submit(tpu_pod(
-                    name, chips=spec["chips"],
-                    gang=GangSpec(name=f"gang{g}", size=spec["pods"],
-                                  index=i),
-                    mesh_axes=spec["axes"], command=["x"]))
-        cl.step()
-        # queue-drain model: if the gang didn't fit, complete live gangs
-        # one at a time until it does — the allocator always works
-        # against a fragmented, partially-occupied cluster, and every
-        # successful placement latency lands in the histogram.
-        while not gang_placed(names) and live:
-            finish_one(live)
-            cl.step()
-        if gang_placed(names):
-            live.append(names)
-        # background churn keeps occupancy realistic (~40% completion)
-        if len(live) > 4 and rng.random() < 0.4:
-            finish_one(live)
-    cl.reap()
-    snap = cl.metrics.snapshot()
-    hist = snap["histograms"].get("schedule_latency_ms", {})
-    loc = snap["histograms"].get("allocation_locality", {})
-    p50 = hist.get("p50", 0.0)
-    return {
-        "metric": "gang_schedule_p50_latency",
-        "value": round(p50, 3),
-        "unit": "ms",
-        # 0.0 (not inf) when nothing scheduled: a broken run must not
-        # read as a record win
-        "vs_baseline": round(BASELINE_P50_MS / p50, 2) if p50 > 0 else 0.0,
-        "details": {
-            "p90_ms": round(hist.get("p90", 0.0), 3),
-            "p99_ms": round(hist.get("p99", 0.0), 3),
-            "decisions": hist.get("count", 0),
-            "gangs_scheduled": snap["counters"].get("gangs_scheduled", 0),
-            "unschedulable": snap["counters"].get(
-                "schedule_unschedulable", 0),
-            "mean_allocation_locality": round(loc.get("mean", 0.0), 4),
-            "baseline_p50_ms": BASELINE_P50_MS,
-        },
-    }
-
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from kubegpu_tpu.benchmark import run_bench
     n = int(os.environ.get("BENCH_GANGS", "60"))
     print(json.dumps(run_bench(n_gangs=n)))

@@ -1,0 +1,7 @@
+"""``python -m kubegpu_tpu`` → the kubetpu CLI."""
+
+import sys
+
+from kubegpu_tpu.cli import main
+
+sys.exit(main())

@@ -62,24 +62,51 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
 
 class SimCluster:
     def __init__(self, slice_types: list[str], real_processes: bool = False,
-                 extra_env: dict[str, str] | None = None):
+                 extra_env: dict[str, str] | None = None,
+                 config: "KubeTpuConfig | None" = None):
+        from kubegpu_tpu.allocator import GangAllocator
+        from kubegpu_tpu.config import KubeTpuConfig
+
+        cfg = config or KubeTpuConfig()
+        self.config = cfg
         self.api = FakeApiServer()
         self.metrics = MetricsRegistry()
-        self.trace = ScheduleTrace()
-        if real_processes:
-            self.runtime = SubprocessRuntime(extra_env=extra_env)
+        self.trace = ScheduleTrace(capacity=cfg.obs.trace_capacity)
+        if real_processes or cfg.runtime.real_processes:
+            merged_env = {**cfg.runtime.extra_env, **(extra_env or {})}
+            self.runtime = SubprocessRuntime(extra_env=merged_env)
         else:
             self.runtime = FakeRuntime()
         self.agents = [NodeAgent(self.api, b, self.runtime)
                        for b in mock_cluster(slice_types)]
         for a in self.agents:
             a.register()
+        sc = cfg.scheduler
         self.scheduler = DeviceScheduler(
-            self.api, metrics=self.metrics, trace=self.trace,
-            coordinator_port=pick_coordinator_port())
+            self.api,
+            allocator=GangAllocator(
+                max_placements_per_shape=sc.max_placements_per_shape,
+                locality_weight=sc.locality_weight,
+                frag_weight=sc.frag_weight,
+                fill_weight=sc.fill_weight),
+            metrics=self.metrics, trace=self.trace,
+            # explicit config port wins; 0 = auto, rotating per cluster so
+            # parallel tests' jax.distributed coordinators never collide
+            coordinator_port=sc.coordinator_port or pick_coordinator_port())
         self.recovery = FaultRecoveryController(
             self.api, self.scheduler, metrics=self.metrics, trace=self.trace)
         self._unsub = self.api.watch(self._on_event)
+
+    @classmethod
+    def from_config(cls, cfg: "KubeTpuConfig") -> "SimCluster":
+        """Build a cluster entirely from the config tree (SURVEY.md §6
+        config row: backend selection is a config field, mirroring the
+        reference's plugin seam)."""
+        if cfg.backend.type != "mock":
+            raise NotImplementedError(
+                "libtpu backend needs real hardware; SimCluster is the "
+                "simulated control plane (use the mock backend)")
+        return cls(list(cfg.backend.slice_types), config=cfg)
 
     # -- lifecycle events: free resources when pods finish/disappear -----
 

@@ -130,14 +130,13 @@ class FaultRecoveryController:
         """Trial re-placement with this gang's chips freed: is there an
         assignment on a different footprint?  (Scoring already penalizes
         bad links, so a different footprint means a better one.)"""
-        members = []
-        for name, g in self.scheduler._pod_gang.items():
-            if g == gang:
-                try:
-                    members.append(self.api.get("Pod", name))
-                except NotFound:
-                    return False
-        if not members:
+        member_names = {n for n, g in self.scheduler._pod_gang.items()
+                        if g == gang}
+        # list() spans namespaces; _pod_gang keys are bare names (the
+        # scheduler's gang map assumes cluster-unique pod names)
+        members = [p for p in self.api.list("Pod")
+                   if p.name in member_names]
+        if len(members) != len(member_names):
             return False
         try:
             if len(members) == 1 and not members[0].metadata.annotations.get(
@@ -163,15 +162,12 @@ class FaultRecoveryController:
 
     def _evict_gang(self, gang: str, asg: GangAssignment, reason: str,
                     result: RecoveryResult) -> None:
-        members = [p for p, g in self.scheduler._pod_gang.items() if g == gang]
+        member_names = {n for n, g in self.scheduler._pod_gang.items()
+                        if g == gang}
         self.trace.record("evict", gang=gang, detail={
-            "reason": reason, "pods": sorted(members)})
-        pods: list[Pod] = []
-        for name in members:
-            try:
-                pods.append(self.api.get("Pod", name))
-            except NotFound:
-                pass
+            "reason": reason, "pods": sorted(member_names)})
+        pods: list[Pod] = [p for p in self.api.list("Pod")
+                           if p.name in member_names]
         # Delete first (kills containers via node-agent reconcile, frees the
         # allocation via the scheduler's return-resources path), then
         # recreate pending replacements.

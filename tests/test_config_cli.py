@@ -1,0 +1,157 @@
+"""Config tree (SURVEY.md §6 config row) + kubetpu CLI (user surface)."""
+
+import json
+
+import pytest
+
+from kubegpu_tpu.cli import main, pods_from_spec
+from kubegpu_tpu.config import KubeTpuConfig
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = KubeTpuConfig()
+        assert cfg.backend.type == "mock"
+        assert cfg.scheduler.locality_weight == 0.6
+
+    def test_file_and_overrides(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({
+            "scheduler": {"locality_weight": 0.7},
+            "backend": {"slice_types": ["v5e-16", "v4-8"]},
+        }))
+        cfg = KubeTpuConfig.load(str(p), overrides=[
+            "scheduler.frag_weight=0.2",
+            "runtime.real_processes=true",
+            "runtime.extra_env=JAX_PLATFORMS:cpu",
+        ])
+        assert cfg.scheduler.locality_weight == 0.7
+        assert cfg.scheduler.frag_weight == 0.2
+        assert cfg.backend.slice_types == ["v5e-16", "v4-8"]
+        assert cfg.runtime.real_processes is True
+        assert cfg.runtime.extra_env == {"JAX_PLATFORMS": "cpu"}
+
+    def test_yaml_file(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("scheduler:\n  fill_weight: 0.1\n")
+        assert KubeTpuConfig.load(str(p)).scheduler.fill_weight == 0.1
+
+    def test_unknown_key_rejected(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({"scheduler": {"nope": 1}}))
+        with pytest.raises(ValueError, match="unknown config key"):
+            KubeTpuConfig.load(str(p))
+        with pytest.raises(ValueError, match="unknown config key"):
+            KubeTpuConfig.load(overrides=["scheduler.nope=1"])
+
+    def test_override_of_section_rejected(self):
+        """`--set backend=libtpu` must error, not replace the section
+        dataclass with a string."""
+        with pytest.raises(ValueError, match="config section"):
+            KubeTpuConfig.load(overrides=["backend=libtpu"])
+
+    def test_bad_backend_type_rejected(self):
+        with pytest.raises(ValueError, match="unknown backend"):
+            KubeTpuConfig.load(overrides=["backend.type=cuda"])
+
+    def test_type_mismatch_rejected(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({"scheduler": {"locality_weight": "high"}}))
+        with pytest.raises(ValueError, match="expected float"):
+            KubeTpuConfig.load(str(p))
+
+    def test_round_trip(self):
+        cfg = KubeTpuConfig.load(overrides=["scheduler.locality_weight=0.9"])
+        again = KubeTpuConfig.from_dict(cfg.to_dict())
+        assert again.to_dict() == cfg.to_dict()
+
+    def test_cluster_uses_config(self):
+        from kubegpu_tpu.cluster import SimCluster
+        cfg = KubeTpuConfig.load(overrides=[
+            "backend.slice_types=v4-8",
+            "scheduler.locality_weight=0.9",
+            "scheduler.coordinator_port=9321",
+        ])
+        cl = SimCluster.from_config(cfg)
+        assert cl.scheduler.allocator.locality_weight == 0.9
+        assert cl.scheduler.coordinator_port == 9321
+        assert len(cl.agents) == 1
+        cl.close()
+
+
+class TestSpecParsing:
+    def test_gang_expansion_and_fields(self):
+        pods, slices = pods_from_spec({
+            "cluster": {"slices": ["v5e-16"]},
+            "pods": [
+                {"name": "llama", "gang": 4, "chips": 4,
+                 "mesh_axes": {"dp": 4, "tp": 4},
+                 "command": ["noop"], "env": {"A": "1"}},
+                {"name": "frac", "millitpu": 250},
+            ],
+        })
+        assert slices == ["v5e-16"]
+        assert [p.name for p in pods] == [
+            "llama-0", "llama-1", "llama-2", "llama-3", "frac"]
+        assert pods[0].spec.total_chips == 4
+        assert pods[4].spec.total_millitpu == 250
+
+    def test_gang_dict_with_name(self):
+        pods, _ = pods_from_spec({"pods": [
+            {"name": "w", "gang": {"name": "myjob", "size": 2}, "chips": 1},
+        ]})
+        from kubegpu_tpu.kubemeta.codec import pod_gang_spec
+        assert pod_gang_spec(pods[0]).name == "myjob"
+        assert pod_gang_spec(pods[1]).index == 1
+
+
+class TestCli:
+    def test_slices_and_configs(self, capsys):
+        assert main(["slices"]) == 0
+        assert "v5e-64" in capsys.readouterr().out
+        assert main(["configs"]) == 0
+        assert "config4" in capsys.readouterr().out
+
+    def test_apply_schedule_only_with_top(self, tmp_path, capsys):
+        spec = tmp_path / "spec.json"
+        spec.write_text(json.dumps({
+            "cluster": {"slices": ["v4-8"]},
+            "pods": [{"name": "p", "chips": 4,
+                      "mesh_axes": {"dp": 4}, "command": ["noop"]}],
+        }))
+        trace = tmp_path / "trace.json"
+        rc = main(["apply", "-f", str(spec), "--schedule-only", "--top",
+                   "--trace-out", str(trace)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Running" in out
+        assert "v4-8-slice-0" in out     # occupancy map header
+        assert "a a" in out              # gang letters in the map
+        events = json.loads(trace.read_text())
+        assert any(e["kind"] == "schedule" for e in events)
+
+    def test_bench_verb(self, capsys):
+        assert main(["bench", "--gangs", "5"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["metric"] == "gang_schedule_p50_latency"
+        assert out["value"] > 0
+
+    def test_demo_dry(self, capsys):
+        assert main(["demo", "config5"]) == 0
+        out = capsys.readouterr().out
+        assert "tenant-b-1" in out and "fill" in out
+
+    def test_apply_runs_workload_to_completion(self, tmp_path, capsys):
+        """Real subprocess through the CLI: schedule → inject → run."""
+        spec = tmp_path / "spec.json"
+        spec.write_text(json.dumps({
+            "cluster": {"slices": ["v4-8"]},
+            "pods": [{"name": "mnist", "chips": 1,
+                      "command": ["python", "-m",
+                                  "kubegpu_tpu.workloads.programs.mnist_mlp"],
+                      "env": {"KUBETPU_EXPECT_CHIPS": "1"}}],
+        }))
+        rc = main(["apply", "-f", str(spec), "--real", "--timeout", "120"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Succeeded" in out
