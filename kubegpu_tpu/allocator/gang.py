@@ -379,18 +379,96 @@ def _block_orders(topo: TpuTopology, placement: Placement,
     ring's span of blocks is closed into a physical cycle — e.g. a tp=16
     ring over four 2x2 host blocks becomes a 16-chip ICI cycle."""
     orders: list[list[Coord]] = []
+    seen: set[tuple] = set()
+
+    def add(o: list[Coord] | None) -> None:
+        if o is not None and tuple(o) not in seen:
+            seen.add(tuple(o))
+            orders.append(o)
+
     for seq in _block_sequences(topo, placement):
-        orders.append(_orient_rings(seq, close=len(seq) > 2))
-        if ring_span:
-            cph = len(seq[0])
-            span_blocks = ring_span // cph if ring_span % cph == 0 else 0
-            if span_blocks > 1 and len(seq) % span_blocks == 0:
-                grouped: list[Coord] = []
-                for g in range(0, len(seq), span_blocks):
-                    grouped.extend(
-                        _orient_rings(seq[g:g + span_blocks], close=True))
-                orders.append(grouped)
+        add(_orient_rings(seq, close=len(seq) > 2))
+        if not ring_span:
+            continue
+        cph = len(seq[0])
+        if ring_span == cph and len(seq) >= 2:
+            # fast axis = one host block: align the per-block cycles so
+            # the NEXT axis's position-wise pairs ride ICI too
+            add(_align_units([_block_cycle_options(b)[0] for b in seq],
+                             step=1))
+            continue
+        span_blocks = ring_span // cph if ring_span % cph == 0 else 0
+        if span_blocks > 1 and len(seq) % span_blocks == 0:
+            # fast axis spans several blocks: close each group's ring once,
+            # reuse the oriented groups both concatenated and aligned
+            units = [_orient_rings(seq[g:g + span_blocks], close=True)
+                     for g in range(0, len(seq), span_blocks)]
+            add([c for u in units for c in u])
+            if len(units) >= 2:
+                add(_align_units(units, step=cph))
     return orders
+
+
+def _cycle_variants(cycle: list[Coord], step: int) -> list[list[Coord]]:
+    """Rotations (by multiples of ``step``, preserving chunk boundaries)
+    and reversals of a chip cycle — the orientation freedom of one ring."""
+    n = len(cycle)
+    outs = []
+    for r in range(0, n, max(step, 1)):
+        rot = cycle[r:] + cycle[:r]
+        outs.append(rot)
+        outs.append(list(reversed(rot)))
+    return outs
+
+
+def _align_units(units: list[list[Coord]], step: int) -> list[Coord] | None:
+    """Choose an orientation per ring so POSITION-WISE pairs between
+    consecutive rings (and last→first) maximize ICI adjacency.
+
+    This is the second-axis problem the global-ring orders can't solve:
+    with pods pinned to host blocks, the fastest logical axis rides each
+    block's internal cycle, while the next axis pairs chip *i* of ring k
+    with chip *i* of ring k+1 — a dp/fsdp gradient ring across blocks.
+    Viterbi over ≤2n orientations per ring; unit 0 is fixed to identity or
+    reversal WLOG (a global rotation applied to every ring preserves all
+    pairwise gains, intra-ring rings, and chunk boundaries).
+    """
+    if len(units) < 2 or len({len(u) for u in units}) != 1:
+        return None
+    options = [_cycle_variants(u, step) for u in units]
+
+    def gain(a: list[Coord], b: list[Coord]) -> int:
+        return sum(1 for p, q in zip(a, b) if _dist(p, q) == 1)
+
+    best_total, best_seq = -1, None
+    for start in options[0][:2]:  # identity + reversal (see docstring)
+        score = {j: gain(start, opt) for j, opt in enumerate(options[1])}
+        back: list[dict[int, int]] = []
+        for i in range(2, len(units)):
+            nscore: dict[int, int] = {}
+            nback: dict[int, int] = {}
+            for j, opt in enumerate(options[i]):
+                bj, bs = None, -1
+                for pj, ps in score.items():
+                    s = ps + gain(options[i - 1][pj], opt)
+                    if s > bs:
+                        bs, bj = s, pj
+                nscore[j] = bs
+                nback[j] = bj
+            back.append(nback)
+            score = nscore
+        for j, s in score.items():
+            total = s + gain(options[-1][j], start)  # close the loop
+            if total > best_total:
+                path = [j]
+                for nb in reversed(back):
+                    path.append(nb[path[-1]])
+                path.reverse()
+                seq = list(start)
+                for i, pj in enumerate(path, start=1):
+                    seq.extend(options[i][pj])
+                best_total, best_seq = total, seq
+    return best_seq
 
 
 def _chunks_host_local(topo: TpuTopology, order: list[Coord], c: int) -> bool:

@@ -23,6 +23,7 @@ import itertools
 from kubegpu_tpu.topology.locality import (
     TrafficModel,
     ici_locality,
+    resolve_axis_weights,
     traffic_pairs_for_mesh_axes,
 )
 from kubegpu_tpu.topology.mesh import Coord, TpuTopology
@@ -44,6 +45,7 @@ def evaluate_order(
     """
     from kubegpu_tpu.allocator import _native
 
+    axis_weights = resolve_axis_weights(axes, axis_weights)
     if not bad_links:
         native = _native.eval_order_native(topo, order, axes, axis_weights)
         if native is not None:

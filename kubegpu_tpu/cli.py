@@ -211,8 +211,10 @@ def cmd_demo(args) -> int:
     render_pod_table(cl)
     print()
     render_top(cl)
+    bad = [p for p in cl.api.list("Pod")
+           if p.status.phase == PodPhase.FAILED]
     cl.close()
-    return 0
+    return 1 if bad else 0
 
 
 def cmd_bench(args) -> int:
@@ -240,7 +242,8 @@ def cmd_slices(args) -> int:
 def cmd_configs(args) -> int:
     from kubegpu_tpu.workloads.specs import ALL_CONFIGS
     for name, fn in sorted(ALL_CONFIGS.items()):
-        print(f"{name}: {(fn.__doc__ or '').strip().splitlines()[0]}")
+        doc = ((fn.__doc__ or "").strip().splitlines() or [""])[0]
+        print(f"{name}: {doc}")
     return 0
 
 

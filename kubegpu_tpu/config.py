@@ -90,7 +90,12 @@ def load_structured_file(path: str) -> dict:
     with open(path) as f:
         text = f.read()
     if path.endswith((".yaml", ".yml")):
-        import yaml
+        try:
+            import yaml
+        except ImportError as e:
+            raise ValueError(
+                f"{path}: reading YAML requires pyyaml (pip install "
+                f"pyyaml) — or use JSON") from e
         return yaml.safe_load(text) or {}
     return json.loads(text or "{}")
 
@@ -153,9 +158,13 @@ def _coerce(cur, val, where: str):
         if isinstance(val, bool):
             return val
         raise ValueError(f"{where}: expected bool, got {val!r}")
-    if isinstance(cur, float) and isinstance(val, (int, float)):
+    # bool is a subclass of int: reject it explicitly in numeric slots so
+    # YAML 1.1 scalars like `on`/`yes` don't silently become 1.0
+    if isinstance(cur, float) and isinstance(val, (int, float)) \
+            and not isinstance(val, bool):
         return float(val)
-    if isinstance(cur, int) and isinstance(val, int):
+    if isinstance(cur, int) and isinstance(val, int) \
+            and not isinstance(val, bool):
         return val
     if isinstance(cur, str) and isinstance(val, str):
         return val

@@ -130,13 +130,8 @@ class FaultRecoveryController:
         """Trial re-placement with this gang's chips freed: is there an
         assignment on a different footprint?  (Scoring already penalizes
         bad links, so a different footprint means a better one.)"""
-        member_names = {n for n, g in self.scheduler._pod_gang.items()
-                        if g == gang}
-        # list() spans namespaces; _pod_gang keys are bare names (the
-        # scheduler's gang map assumes cluster-unique pod names)
-        members = [p for p in self.api.list("Pod")
-                   if p.name in member_names]
-        if len(members) != len(member_names):
+        members = self._gang_member_pods(gang)
+        if len(members) != len(asg.pods):
             return False
         try:
             if len(members) == 1 and not members[0].metadata.annotations.get(
@@ -160,14 +155,23 @@ class FaultRecoveryController:
         new = {ch.coord for p in alt.pods for ch in p.chips}
         return (alt.slice_id, new) != (asg.slice_id, cur)
 
+    def _gang_member_pods(self, gang: str) -> list[Pod]:
+        """Members identified by their allocation's gang name (annotation
+        truth) — never by bare pod name, which can collide across
+        namespaces."""
+        from kubegpu_tpu.kubemeta import pod_allocation
+        out = []
+        for p in self.api.list("Pod"):
+            alloc = pod_allocation(p)
+            if alloc is not None and (alloc.gang_name or p.name) == gang:
+                out.append(p)
+        return out
+
     def _evict_gang(self, gang: str, asg: GangAssignment, reason: str,
                     result: RecoveryResult) -> None:
-        member_names = {n for n, g in self.scheduler._pod_gang.items()
-                        if g == gang}
+        pods = self._gang_member_pods(gang)
         self.trace.record("evict", gang=gang, detail={
-            "reason": reason, "pods": sorted(member_names)})
-        pods: list[Pod] = [p for p in self.api.list("Pod")
-                           if p.name in member_names]
+            "reason": reason, "pods": sorted(p.name for p in pods)})
         # Delete first (kills containers via node-agent reconcile, frees the
         # allocation via the scheduler's return-resources path), then
         # recreate pending replacements.

@@ -16,6 +16,34 @@ from dataclasses import dataclass, field
 
 from kubegpu_tpu.topology.mesh import Coord, TpuTopology
 
+# Default relative collective volume per parallelism axis, used when the
+# workload doesn't declare weights.  Proportional to bytes moved per
+# training step in a sharded transformer: tp allreduces activations every
+# layer (dominant); sp/cp ring-exchange KV blocks every layer; fsdp
+# all-gathers params per layer; ep all-to-alls per MoE layer; dp syncs
+# grads once per step.  This is what makes the locality figure "honest"
+# (SURVEY.md §8): a dead dp hop costs far less than a dead tp hop, and the
+# score reflects that.
+DEFAULT_AXIS_WEIGHTS = {
+    "tp": 8.0,
+    "sp": 4.0,
+    "cp": 4.0,
+    "ep": 2.0,
+    "fsdp": 2.0,
+    "dp": 1.0,
+}
+
+
+def resolve_axis_weights(
+    axis_sizes: dict[str, int],
+    axis_weights: dict[str, float] | None,
+) -> dict[str, float]:
+    """Explicit weights win; otherwise look up by conventional axis name
+    (unknown names weigh 1.0)."""
+    if axis_weights is not None:
+        return axis_weights
+    return {k: DEFAULT_AXIS_WEIGHTS.get(k, 1.0) for k in axis_sizes}
+
 
 @dataclass
 class TrafficModel:
@@ -71,7 +99,7 @@ def traffic_pairs_for_mesh_axes(
         total *= s
     if total != len(coords):
         raise ValueError(f"mesh axes {axis_sizes} ≠ {len(coords)} chips")
-    weights = axis_weights or {}
+    weights = resolve_axis_weights(axis_sizes, axis_weights)
     tm = TrafficModel()
     # strides for row-major logical indexing
     strides = [1] * len(sizes)

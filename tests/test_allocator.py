@@ -175,6 +175,42 @@ class TestLocalityScoring:
         assert len(order) == 16
 
 
+class TestNorthStarLocality:
+    """BASELINE.md north-star: ≥90% ICI-link locality for sharded gangs.
+
+    Locality is traffic-volume-weighted (tp ≫ dp — see
+    DEFAULT_AXIS_WEIGHTS); each bench workload shape must clear 0.90 on an
+    empty v5e-64."""
+
+    @pytest.mark.parametrize("pods,chips,axes", [
+        (4, 1, {"dp": 4}),
+        (4, 4, {"dp": 4, "tp": 4}),
+        (16, 4, {"dp": 4, "tp": 16}),
+        (8, 4, {"dp": 2, "tp": 16}),
+        (1, 4, {"dp": 1, "tp": 4}),
+        (4, 4, {"dp": 4, "sp": 4}),       # ring-attention sequence axis
+    ])
+    def test_bench_shapes_meet_north_star(self, pods, chips, axes):
+        st = make_slice("v5e-64")
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest(gang_name="g", num_pods=pods,
+                              chips_per_pod=chips, mesh_axes=axes))
+        assert asg is not None
+        assert asg.locality >= 0.90, (axes, asg.locality)
+
+    def test_llama_v5e64_tp_dp_full_slice(self):
+        """The headline config: Llama-3-8B pjit gang filling v5e-64."""
+        st = make_slice("v5e-64")
+        asg = GangAllocator().find_assignment(
+            [st], GangRequest(gang_name="llama", num_pods=16,
+                              chips_per_pod=4,
+                              mesh_axes={"dp": 4, "tp": 16}))
+        assert asg is not None
+        assert asg.locality >= 0.90, asg.locality
+        # every pod host-local, worker ids dense
+        assert [p.pod_index for p in asg.pods] == list(range(16))
+
+
 class TestFractional:
     def test_fractional_binpacks(self):
         """BASELINE config 5: two fractional jobs share one chip."""
