@@ -1,0 +1,25 @@
+# KubeTPU build entry points (reference parity: the reference's Makefile
+# built its two binaries + plugin .so files; here the native artifact is
+# the C++ allocator core and everything else is Python).
+
+PY ?= python
+
+.PHONY: all native asan test bench clean
+
+all: native
+
+native:                         # C++ allocator core (auto-built on import too)
+	$(MAKE) -C kubegpu_tpu/allocator/csrc
+
+asan:                           # sanitizer build + run (ASan/UBSan)
+	$(MAKE) -C kubegpu_tpu/allocator/csrc asan
+	./kubegpu_tpu/allocator/csrc/sanitize_check
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+clean:
+	$(MAKE) -C kubegpu_tpu/allocator/csrc clean

@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 
 from kubegpu_tpu.allocator.gang import GangAssignment, SliceState
 from kubegpu_tpu.kubemeta import FakeApiServer, NotFound, Pod, PodPhase
-from kubegpu_tpu.kubemeta.codec import ALLOCATE_FROM_KEY, GANG_KEY
+from kubegpu_tpu.kubemeta.codec import ALLOCATE_FROM_KEY
 from kubegpu_tpu.kubemeta.controlplane import WatchEvent
 from kubegpu_tpu.kubemeta.objects import ObjectMeta, PodStatus
 from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
@@ -129,17 +129,25 @@ class FaultRecoveryController:
                                  asg: GangAssignment) -> bool:
         """Trial re-placement with this gang's chips freed: is there an
         assignment on a different footprint?  (Scoring already penalizes
-        bad links, so a different footprint means a better one.)"""
-        members = self._gang_member_pods(gang)
-        if len(members) != len(asg.pods):
+        bad links, so a different footprint means a better one.)
+
+        The trial request is rebuilt from the committed assignment itself
+        — not from live member pods — so partially-completed or
+        already-garbage-collected members can't skew the shape."""
+        from kubegpu_tpu.allocator import GangRequest
+        from kubegpu_tpu.kubemeta import pod_mesh_axes
+
+        if not asg.pods or not asg.pods[0].chips:
             return False
+        chips_per_pod = len(asg.pods[0].chips)
+        members = self._gang_member_pods(gang)
+        axes = pod_mesh_axes(members[0]) if members else None
         try:
-            if len(members) == 1 and not members[0].metadata.annotations.get(
-                    GANG_KEY):
-                req = self.scheduler._request_for_single(members[0])
-            else:
-                members.sort(key=lambda p: p.name)
-                req = self.scheduler._request_for_gang(gang, members)
+            req = GangRequest(
+                gang_name=gang, num_pods=len(asg.pods),
+                chips_per_pod=chips_per_pod,
+                mesh_axes=self.scheduler._sane_axes(
+                    axes, len(asg.pods) * chips_per_pod))
         except ValueError:
             return False
         alloc = self.scheduler.allocator
@@ -156,12 +164,16 @@ class FaultRecoveryController:
         return (alt.slice_id, new) != (asg.slice_id, cur)
 
     def _gang_member_pods(self, gang: str) -> list[Pod]:
-        """Members identified by their allocation's gang name (annotation
-        truth) — never by bare pod name, which can collide across
-        namespaces."""
+        """LIVE members identified by their allocation's gang name
+        (annotation truth) — never by bare pod name, which can collide
+        across namespaces.  Terminal pods are excluded: a completed member
+        keeps its allocation annotation, and evicting it would silently
+        resurrect and re-run a finished workload."""
         from kubegpu_tpu.kubemeta import pod_allocation
         out = []
         for p in self.api.list("Pod"):
+            if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
             alloc = pod_allocation(p)
             if alloc is not None and (alloc.gang_name or p.name) == gang:
                 out.append(p)

@@ -239,6 +239,27 @@ class TestLinkFailure:
         cl.close()
 
 
+class TestCompletedMembers:
+    def test_eviction_does_not_resurrect_completed_pod(self):
+        """Regression: a SUCCEEDED gang member keeps its allocation
+        annotation; a later fault on the gang must evict only LIVE
+        members, not re-run the finished one."""
+        cl = SimCluster(["v5e-16", "v5e-16"])
+        names = submit_gang(cl, "job", size=4, chips=2)
+        cl.step()
+        # one member finishes early
+        cl.api.set_pod_phase(names[3], PodPhase.SUCCEEDED, exit_code=0)
+        victim = pod_allocation(cl.api.get("Pod", names[0]))
+        cl.fail_host(victim.node_name)
+        cl.step()
+        done = cl.api.get("Pod", names[3])
+        assert done.status.phase == PodPhase.SUCCEEDED  # untouched
+        for n in names[:3]:
+            assert cl.api.get("Pod", n).status.phase in (
+                PodPhase.SCHEDULED, PodPhase.RUNNING, PodPhase.PENDING)
+        cl.close()
+
+
 class TestRestartRecovery:
     def test_fresh_scheduler_detects_fault_from_annotations(self):
         """Scheduler + recovery controller restart: all state (allocations,

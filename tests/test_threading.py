@@ -1,0 +1,105 @@
+"""Threading-stress on the scheduling stack (SURVEY.md §6 race-detection
+row): the scheduler loop runs in its own thread, as in a real deployment,
+while other threads churn pods and flip node health through the apiserver.
+Everything coordinates through FakeApiServer (thread-safe); the invariants
+checked are the allocator's no-double-booking guarantees."""
+
+import random
+import threading
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, NotFound, PodPhase
+from kubegpu_tpu.tpuplugin.backend import MILLICHIPS_PER_CHIP
+
+
+def test_scheduler_loop_vs_churn_and_faults():
+    cl = SimCluster(["v5e-16", "v4-8"])
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # surfaced after join
+                errors.append(e)
+                stop.set()
+        return run
+
+    def scheduler_loop():
+        while not stop.is_set():
+            cl.step()
+            cl.reap(timeout=0)
+
+    def submitter():
+        rng = random.Random(1)
+        i = 0
+        while not stop.is_set() and i < 60:
+            i += 1
+            size = rng.choice([1, 2, 4])
+            chips = rng.choice([1, 2])
+            if size == 1:
+                cl.submit(tpu_pod(f"s{i}", chips=chips, command=["x"]))
+            else:
+                cl.submit(*[
+                    tpu_pod(f"g{i}-{k}", chips=chips,
+                            gang=GangSpec(name=f"g{i}", size=size, index=k),
+                            command=["x"])
+                    for k in range(size)])
+
+    def reaper():
+        rng = random.Random(2)
+        while not stop.is_set():
+            pods = [p for p in cl.api.list("Pod")
+                    if p.status.phase != PodPhase.PENDING]
+            if pods:
+                victim = rng.choice(pods)
+                try:
+                    cl.api.delete("Pod", victim.name,
+                                  namespace=victim.metadata.namespace)
+                except NotFound:
+                    pass
+
+    def health_flipper():
+        rng = random.Random(3)
+        nodes = [a.node_name for a in cl.agents]
+        while not stop.is_set():
+            n = rng.choice(nodes)
+            try:
+                cl.api.set_node_ready(n, rng.random() < 0.7)
+            except NotFound:
+                pass
+
+    threads = [threading.Thread(target=guard(f), daemon=True)
+               for f in (scheduler_loop, submitter, reaper, health_flipper)]
+    for t in threads:
+        t.start()
+    # let them contend, then stop
+    threads[1].join(timeout=20)  # submitter finishes its 60 gangs
+    stop.set()
+    for t in threads:
+        t.join(timeout=20)
+    assert not errors, errors[0]
+
+    # restore every node, settle, and check invariants against truth
+    for a in cl.agents:
+        cl.api.set_node_ready(a.node_name, True)
+        a.advertise()
+    cl.step()
+    for st in cl.scheduler.slices.values():
+        for coord, used in st.used_millichips.items():
+            assert 0 <= used <= MILLICHIPS_PER_CHIP, (coord, used)
+    seen = {}
+    for gang, asg in cl.scheduler._committed.items():
+        for p in asg.pods:
+            for ch in p.chips:
+                if ch.millichips == MILLICHIPS_PER_CHIP:
+                    key = (asg.slice_id, ch.coord)
+                    assert key not in seen, (key, gang, seen[key])
+                    seen[key] = gang
+    # annotation truth agrees with the cache after a full re-sync
+    cl.scheduler.sync()
+    for st in cl.scheduler.slices.values():
+        for coord, used in st.used_millichips.items():
+            assert 0 <= used <= MILLICHIPS_PER_CHIP, (coord, used)
+    cl.close()

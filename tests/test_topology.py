@@ -181,10 +181,15 @@ class TestLocality:
         # tp axis local, dp axis non-adjacent (distance 2): weighting tp
         # heavily must raise the score
         coords = [(0, 0, 0), (0, 1, 0), (2, 0, 0), (2, 1, 0)]
-        tm_flat = traffic_pairs_for_mesh_axes(coords, {"dp": 2, "tp": 2})
+        # explicit flat baseline: None now resolves to DEFAULT_AXIS_WEIGHTS
+        tm_flat = traffic_pairs_for_mesh_axes(
+            coords, {"dp": 2, "tp": 2}, axis_weights={"tp": 1.0, "dp": 1.0})
         tm_tp = traffic_pairs_for_mesh_axes(
             coords, {"dp": 2, "tp": 2}, axis_weights={"tp": 10.0, "dp": 1.0})
         assert ici_locality(t, tm_tp) > ici_locality(t, tm_flat)
+        # and the default itself is tp-weighted (volume model)
+        tm_default = traffic_pairs_for_mesh_axes(coords, {"dp": 2, "tp": 2})
+        assert ici_locality(t, tm_default) > ici_locality(t, tm_flat)
 
     def test_mesh_size_mismatch_raises(self):
         with pytest.raises(ValueError):
