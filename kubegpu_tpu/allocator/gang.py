@@ -663,28 +663,40 @@ class GangAllocator:
     def _find_fractional(self, slices: list[SliceState],
                          req: GangRequest) -> GangAssignment | None:
         """Best-fit-decreasing: prefer the most-used chip that still fits,
-        keeping whole chips free for slice placements (BASELINE config 5)."""
+        keeping whole chips free for slice placements (BASELINE config 5).
+
+        Tie-breaks (in order) minimize damage to future *gang* placements:
+        pick the smallest slice (keep big contiguous meshes whole), then a
+        corner chip (fragment an edge, not the middle), then stable coord.
+        """
         need = req.millitpu_per_pod
-        best: tuple[int, SliceState, Coord] | None = None
+        best: tuple[tuple, SliceState, Coord] | None = None
         for st in slices:
-            for coord in sorted(st.available):
+            mx, my, mz = st.spec.mesh_shape
+            for coord in st.available:  # key's coord tie-break = determinism
                 free = st.free_millichips(coord)
                 used = st.used_millichips.get(coord, 0)
-                if free >= need:
-                    # prefer max used (tightest fit); tie-break stable coord
-                    if best is None or used > best[0]:
-                        best = (used, st, coord)
+                if free < need:
+                    continue
+                corner_dist = (min(coord[0], mx - 1 - coord[0])
+                               + min(coord[1], my - 1 - coord[1])
+                               + min(coord[2], mz - 1 - coord[2]))
+                key = (-used, len(st.available), corner_dist, coord)
+                if best is None or key < best[0]:
+                    best = (key, st, coord)
         if best is None:
             return None
         _, st, coord = best
+        used = st.used_millichips.get(coord, 0)
         host_id = st.topo.chip_at(coord).host_id
         pod = PodAssignment(
             pod_index=0,
             node_name=st.node_of_host.get(host_id, f"host-{host_id}"),
             host_id=host_id,
             chips=[st._alloc_chip(coord, need)])
-        return GangAssignment(slice_id=st.slice_id, pods=[pod],
-                              locality=1.0, score=5.0 + 5.0 * (best[0] / MILLICHIPS_PER_CHIP))
+        return GangAssignment(
+            slice_id=st.slice_id, pods=[pod], locality=1.0,
+            score=5.0 + 5.0 * (used / MILLICHIPS_PER_CHIP))
 
     # -- helpers for the scheduler --------------------------------------
 

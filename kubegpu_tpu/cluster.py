@@ -92,7 +92,8 @@ class SimCluster:
             metrics=self.metrics, trace=self.trace,
             # explicit config port wins; 0 = auto, rotating per cluster so
             # parallel tests' jax.distributed coordinators never collide
-            coordinator_port=sc.coordinator_port or pick_coordinator_port())
+            coordinator_port=sc.coordinator_port or pick_coordinator_port(),
+            gang_grace_s=sc.gang_grace_s)
         self.recovery = FaultRecoveryController(
             self.api, self.scheduler, metrics=self.metrics, trace=self.trace)
         self._unsub = self.api.watch(self._on_event)

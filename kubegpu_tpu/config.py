@@ -26,6 +26,9 @@ class SchedulerConfig:
     fill_weight: float = 0.15
     max_placements_per_shape: int = 64
     coordinator_port: int = 0  # 0 = auto (rotate per cluster)
+    # incomplete-gang arrival grace: how long the queue head blocks
+    # later-arrived units while a gang's members trickle in
+    gang_grace_s: float = 30.0
 
 
 @dataclass

@@ -63,15 +63,22 @@ class DeviceScheduler:
                  allocator: GangAllocator | None = None,
                  metrics: MetricsRegistry | None = None,
                  trace: ScheduleTrace | None = None,
-                 coordinator_port: int = 8476):
+                 coordinator_port: int = 8476,
+                 gang_grace_s: float = 30.0):
         self.api = api
         self.allocator = allocator or GangAllocator()
         self.metrics = metrics or MetricsRegistry()
         self.trace = trace or ScheduleTrace()
         self.coordinator_port = coordinator_port
+        # How long an INCOMPLETE gang at the head of the queue blocks
+        # later-arrived units (the arrival grace; cf. Volcano/coscheduling
+        # gang timeouts).  Expires → work conservation resumes, so two
+        # half-arrived gangs can never deadlock the queue.
+        self.gang_grace_s = gang_grace_s
         self.slices: dict[str, SliceState] = {}
         self._committed: dict[str, GangAssignment] = {}  # gang → assignment
         self._pod_gang: dict[str, str] = {}              # pod name → gang
+        self._gang_first_seen: dict[str, float] = {}     # incomplete gangs
         self.sync()
 
     # ------------------------------------------------------------------
@@ -189,36 +196,70 @@ class DeviceScheduler:
 
     def run_once(self) -> ScheduleResult:
         """One pass over pending pods: group into gangs, place complete
-        gangs atomically, write allocation annotations, bind."""
+        gangs atomically, write allocation annotations, bind.
+
+        Units (singles and complete gangs) are scheduled in FIFO arrival
+        order — a gang's place in line is its FIRST member's arrival — so
+        a late single can't grab the chip that blocks a gang which was
+        queued ahead of it (fractional pods fragmenting a slice ahead of a
+        whole-slice gang was the observed failure).  An INCOMPLETE gang at
+        the head additionally blocks later units for ``gang_grace_s``
+        after its first member arrived; when the grace expires, later
+        units flow again (deadlock-free work conservation)."""
         result = ScheduleResult()
+        now = time.monotonic()
         pending = [p for p in self.api.list("Pod")
                    if p.status.phase == PodPhase.PENDING
                    and p.spec.node_name is None]
         pending.sort(key=lambda p: p.metadata.resource_version)  # FIFO
         gangs: dict[str, _PendingGang] = {}
-        singles: list[Pod] = []
+        units: list[tuple[str, object]] = []  # FIFO by first member
         for pod in pending:
             gspec = pod_gang_spec(pod)
             if gspec is None:
-                singles.append(pod)
+                units.append(("single", pod))
             else:
-                pg = gangs.setdefault(gspec.name, _PendingGang(spec=gspec))
+                pg = gangs.get(gspec.name)
+                if pg is None:
+                    pg = gangs[gspec.name] = _PendingGang(spec=gspec)
+                    units.append(("gang", gspec.name))
                 pg.pods[gspec.index] = pod
+        # forget incomplete-gang arrival times for gangs no longer pending
+        self._gang_first_seen = {
+            g: t for g, t in self._gang_first_seen.items() if g in gangs}
 
-        for pod in singles:
-            try:
-                req = self._request_for_single(pod)
-            except ValueError as e:
-                self._reject(pod.name, [pod], str(e), result)
+        barrier: str | None = None  # incomplete gang blocking later units
+        for kind, unit in units:
+            if barrier is not None:
+                names = ([unit.name] if kind == "single" else
+                         [p.name for p in gangs[unit].pods.values()])
+                result.held.extend(names)
+                self.trace.record("defer", gang=unit if kind == "gang"
+                                  else unit.name,
+                                  detail={"behind": barrier})
                 continue
-            self._schedule_gang(pod.name, [pod], req, result)
-
-        for gname, pg in gangs.items():
+            if kind == "single":
+                pod = unit
+                try:
+                    req = self._request_for_single(pod)
+                except ValueError as e:
+                    self._reject(pod.name, [pod], str(e), result)
+                    continue
+                self._schedule_gang(pod.name, [pod], req, result)
+                continue
+            gname = unit
+            pg = gangs[gname]
             if not pg.complete():
                 result.held.extend(p.name for p in pg.pods.values())
+                first = self._gang_first_seen.setdefault(gname, now)
+                in_grace = now - first < self.gang_grace_s
                 self.trace.record("hold", gang=gname, detail={
-                    "have": len(pg.pods), "want": pg.spec.size})
+                    "have": len(pg.pods), "want": pg.spec.size,
+                    "blocking": in_grace})
+                if in_grace:
+                    barrier = gname
                 continue
+            self._gang_first_seen.pop(gname, None)
             members = [pg.pods[i] for i in range(pg.spec.size)]
             try:
                 req = self._request_for_gang(gname, members)

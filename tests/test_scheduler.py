@@ -131,3 +131,78 @@ class TestResilience:
         used = sum(sum(st.used_millichips.values())
                    for st in fresh.slices.values())
         assert used == 0
+
+
+class TestFifoFairness:
+    def test_gang_queued_first_beats_later_single(self):
+        """FIFO across unit kinds: a whole-slice gang submitted BEFORE a
+        fractional single must win the slice — previously singles were
+        always scheduled first and a 300-millitpu pod could permanently
+        starve a 16-chip gang (observed via kubetpu apply)."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(*[
+            tpu_pod(f"g-{i}", chips=4,
+                    gang=GangSpec(name="g", size=4, index=i),
+                    command=["x"])
+            for i in range(4)
+        ])
+        cl.submit(tpu_pod("frac", millitpu=300, command=["x"]))
+        result, _ = cl.step()
+        assert set(result.scheduled) == {f"g-{i}" for i in range(4)}
+        assert cl.pod_phase("frac") == PodPhase.PENDING
+        cl.close()
+
+    def test_single_queued_first_still_wins(self):
+        cl = SimCluster(["v5e-16"])
+        cl.submit(tpu_pod("frac", millitpu=300, command=["x"]))
+        cl.submit(*[
+            tpu_pod(f"g-{i}", chips=4,
+                    gang=GangSpec(name="g", size=4, index=i),
+                    command=["x"])
+            for i in range(4)
+        ])
+        result, _ = cl.step()
+        assert "frac" in result.scheduled
+        assert set(result.unschedulable) == {f"g-{i}" for i in range(4)}
+        cl.close()
+
+    def test_incomplete_gang_blocks_later_single_within_grace(self):
+        """An incomplete gang at the queue head holds later units back
+        during its arrival grace — the straggler member must not find the
+        slice fragmented by a single that arrived after the gang."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(*[
+            tpu_pod(f"g-{i}", chips=4,
+                    gang=GangSpec(name="g", size=4, index=i),
+                    command=["x"])
+            for i in range(3)  # member 3 is late
+        ])
+        cl.submit(tpu_pod("frac", millitpu=300, command=["x"]))
+        result, _ = cl.step()
+        assert result.scheduled == []
+        assert "frac" in result.held
+        # straggler arrives → gang gets the whole slice, then frac pends
+        cl.submit(tpu_pod("g-3", chips=4,
+                          gang=GangSpec(name="g", size=4, index=3),
+                          command=["x"]))
+        result, _ = cl.step()
+        assert set(result.scheduled) == {f"g-{i}" for i in range(4)}
+        assert cl.pod_phase("frac") == PodPhase.PENDING
+        cl.close()
+
+    def test_grace_expiry_unblocks_queue(self):
+        """Grace 0: an incomplete gang never blocks — no deadlock when a
+        gang member never shows up."""
+        import kubegpu_tpu.config as cfgmod
+        from kubegpu_tpu.config import KubeTpuConfig
+        cfg = KubeTpuConfig.load(overrides=[
+            "backend.slice_types=v5e-16", "scheduler.gang_grace_s=0"])
+        cl = SimCluster.from_config(cfg)
+        cl.submit(tpu_pod("g-0", chips=4,
+                          gang=GangSpec(name="g", size=4, index=0),
+                          command=["x"]))
+        cl.submit(tpu_pod("solo", chips=1, command=["x"]))
+        result, _ = cl.step()
+        assert "solo" in result.scheduled      # flowed past the held gang
+        assert "g-0" in result.held
+        cl.close()
