@@ -8,5 +8,14 @@ from kubegpu_tpu.models.llama import (
     llama_init,
     llama_param_specs,
 )
+from kubegpu_tpu.models.moe import (
+    MoEConfig,
+    moe_forward,
+    moe_init,
+    moe_param_specs,
+)
 
-__all__ = ["LlamaConfig", "llama_forward", "llama_init", "llama_param_specs"]
+__all__ = [
+    "LlamaConfig", "llama_forward", "llama_init", "llama_param_specs",
+    "MoEConfig", "moe_forward", "moe_init", "moe_param_specs",
+]

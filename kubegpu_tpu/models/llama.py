@@ -159,33 +159,13 @@ def llama_forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     runs as a ppermute ring.
     """
     b, t = tokens.shape
-    hd = cfg.head_dim
     x = jnp.take(params["embed"], tokens, axis=0)
     x = constrain(x, mesh, ("dp", "fsdp"), "sp", None)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
-    if cfg.attn_impl == "ring" and mesh is not None \
-            and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
-        from kubegpu_tpu.parallel.ringattention import (
-            make_sharded_ring_attention,
-        )
-        attend = _gqa_wrap(make_sharded_ring_attention(mesh), cfg)
-    else:
-        attend = lambda q, k, v: attention(q, k, v, causal=True,
-                                           impl=_attn_impl(cfg))
+    attend = select_attend(cfg, mesh)
 
     def layer(x, lp):
-        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        # [B, H, T, D] for the attention kernels
-        o = attend(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                   v.transpose(0, 2, 1, 3))
-        o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
-        o = constrain(o, mesh, ("dp", "fsdp"), "sp", "tp")
-        x = x + (o @ lp["wo"]).astype(x.dtype)
+        x = attention_sublayer(x, lp, cfg, positions, attend, mesh)
         h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
         up = constrain(up, mesh, ("dp", "fsdp"), "sp", "tp")
@@ -202,6 +182,41 @@ def llama_forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
 def _attn_impl(cfg: LlamaConfig) -> str:
     return cfg.attn_impl if cfg.attn_impl != "ring" else "auto"
+
+
+def select_attend(cfg: LlamaConfig, mesh: Mesh | None):
+    """The attention callable for this (config, mesh): the sp ring when
+    requested and the mesh has an sp axis > 1, the flash/XLA kernel
+    otherwise.  Shared by the Llama and MoE forwards."""
+    if cfg.attn_impl == "ring" and mesh is not None \
+            and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        from kubegpu_tpu.parallel.ringattention import (
+            make_sharded_ring_attention,
+        )
+        return _gqa_wrap(make_sharded_ring_attention(mesh), cfg)
+    return lambda q, k, v: attention(q, k, v, causal=True,
+                                     impl=_attn_impl(cfg))
+
+
+def attention_sublayer(x: jax.Array, lp: dict, cfg: LlamaConfig,
+                       positions: jax.Array, attend, mesh: Mesh | None
+                       ) -> jax.Array:
+    """norm → qkv → rope → attention → wo, with residual.  ``lp`` is one
+    layer's (unstacked) parameter dict; shared by Llama and MoE layers."""
+    b, t = x.shape[0], x.shape[1]
+    hd = cfg.head_dim
+    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, hd)
+    k = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    # [B, H, T, D] for the attention kernels
+    o = attend(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+               v.transpose(0, 2, 1, 3))
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
+    o = constrain(o, mesh, ("dp", "fsdp"), "sp", "tp")
+    return x + (o @ lp["wo"]).astype(x.dtype)
 
 
 def _gqa_wrap(ring_fn, cfg: LlamaConfig):
@@ -228,13 +243,18 @@ def next_token_loss(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     return -ll.mean()
 
 
-def make_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh | None = None):
+def make_train_step(cfg, optimizer, mesh: Mesh | None = None,
+                    loss_fn=None):
     """(params, opt_state, tokens) → (params, opt_state, loss), undecorated
-    (callers jit with their shardings)."""
+    (callers jit with their shardings).  ``loss_fn(params, tokens, cfg,
+    mesh)`` defaults to the Llama next-token loss; the MoE step reuses
+    this with its own loss."""
     import optax
 
+    loss_fn = loss_fn if loss_fn is not None else next_token_loss
+
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(next_token_loss)(
+        loss, grads = jax.value_and_grad(loss_fn)(
             params, tokens, cfg, mesh)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

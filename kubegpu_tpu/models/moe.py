@@ -1,0 +1,271 @@
+"""Mixtral-style mixture-of-experts decoder — the expert-parallel (``ep``)
+workload (extends the flagship Llama family; reference example/ has no MoE,
+this is a TPU-native addition the driver's multi-chip dryrun exercises).
+
+TPU-first design choices (GShard/Switch lineage, per the scaling-book
+recipe):
+- routing is expressed as **one-hot dispatch/combine einsums** so the
+  whole MoE layer is dense matmuls on the MXU — no gather/scatter, no
+  dynamic shapes;
+- experts are stored stacked ``[E, ...]`` and sharded on the ``ep`` mesh
+  axis; the dispatch einsum's output is constrained to ``ep`` so GSPMD
+  inserts the canonical all-to-all (token shuffle) over ICI;
+- fixed **expert capacity** (static shapes under jit): tokens over
+  capacity are dropped by position, the standard TPU MoE contract;
+- aux load-balancing loss (Switch §2.2 form: E · Σ_e f_e · p_e) keeps
+  routing from collapsing; returned alongside logits so train steps can
+  weight it.
+
+Note on causality: capacity contention is position-ordered but not
+strictly causal (a later token's earlier-round choice can displace an
+earlier token's later-round slot) — the standard behavior of
+capacity-based MoE training; ``capacity_factor >= n_experts/top_k``
+guarantees zero drops and exact causality.
+
+
+Shares the attention stack with :mod:`kubegpu_tpu.models.llama` — only
+the FFN is replaced by the routed expert FFN.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubegpu_tpu.models.llama import (
+    LlamaConfig, _rmsnorm, attention_sublayer, make_train_step,
+    select_attend,
+)
+from kubegpu_tpu.parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    """Llama backbone + routed-expert FFN."""
+    base: LlamaConfig = field(default_factory=LlamaConfig)
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+
+    @classmethod
+    def mixtral_8x7b_shaped(cls) -> "MoEConfig":
+        return cls(base=LlamaConfig(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_seq_len=8192,
+            rope_theta=1e6), n_experts=8, top_k=2)
+
+    @classmethod
+    def tiny(cls, n_experts: int = 4, top_k: int = 2,
+             capacity_factor: float = 1.25, **base_kw) -> "MoEConfig":
+        return cls(base=LlamaConfig.tiny(**base_kw), n_experts=n_experts,
+                   top_k=top_k, capacity_factor=capacity_factor)
+
+    def capacity(self, tokens_per_group: int) -> int:
+        """Per-expert token capacity for a routing group of that size."""
+        cap = math.ceil(
+            self.top_k * tokens_per_group * self.capacity_factor
+            / self.n_experts)
+        return max(cap, self.top_k)
+
+
+# ---------------------------------------------------------------------------
+# Init / sharding rules
+# ---------------------------------------------------------------------------
+
+def moe_init(key: jax.Array, cfg: MoEConfig) -> dict:
+    """Stacked-layer pytree; expert FFNs carry an extra leading E dim."""
+    b = cfg.base
+    hd = b.head_dim
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+
+    def dense_init(k, shape, scale_dim):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (scale_dim ** -0.5)).astype(b.jdtype)
+
+    ks = jax.random.split(k_layers, 9)
+    L, E = b.n_layers, cfg.n_experts
+    layers = {
+        "attn_norm": jnp.ones((L, b.d_model), b.jdtype),
+        "wq": dense_init(ks[0], (L, b.d_model, b.n_heads * hd), b.d_model),
+        "wk": dense_init(ks[1], (L, b.d_model, b.n_kv_heads * hd), b.d_model),
+        "wv": dense_init(ks[2], (L, b.d_model, b.n_kv_heads * hd), b.d_model),
+        "wo": dense_init(ks[3], (L, b.n_heads * hd, b.d_model),
+                         b.n_heads * hd),
+        "mlp_norm": jnp.ones((L, b.d_model), b.jdtype),
+        # router in f32: tiny matmul, routing decisions are precision-critical
+        "w_router": (jax.random.normal(ks[4], (L, b.d_model, E), jnp.float32)
+                     * (b.d_model ** -0.5)),
+        "w_gate": dense_init(ks[5], (L, E, b.d_model, b.d_ff), b.d_model),
+        "w_up": dense_init(ks[6], (L, E, b.d_model, b.d_ff), b.d_model),
+        "w_down": dense_init(ks[7], (L, E, b.d_ff, b.d_model), b.d_ff),
+    }
+    return {
+        "embed": dense_init(k_emb, (b.vocab_size, b.d_model), b.d_model),
+        "layers": layers,
+        "final_norm": jnp.ones((b.d_model,), b.jdtype),
+        "lm_head": dense_init(k_out, (b.d_model, b.vocab_size), b.d_model),
+    }
+
+
+def moe_param_specs(cfg: MoEConfig) -> dict:
+    """Sharding rules: attention as Llama (fsdp/tp); experts sharded on
+    ``ep`` with tp on the ffn dim — each ep rank holds E/ep whole experts,
+    so expert matmuls need no cross-expert communication at all."""
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_router": P(None, "fsdp", None),
+            "w_gate": P(None, "ep", "fsdp", "tp"),
+            "w_up": P(None, "ep", "fsdp", "tp"),
+            "w_down": P(None, "ep", "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Routed FFN
+# ---------------------------------------------------------------------------
+
+def route_tokens(router_logits: jax.Array, top_k: int, capacity: int
+                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing with fixed capacity.
+
+    router_logits: [G, T, E] (G = routing groups, here the batch dim).
+    Returns (dispatch [G,T,E,C] one-hot float, combine [G,T,E,C] gate
+    weights, aux_loss scalar).  Position-in-expert is assigned by token
+    order (GShard convention); tokens past capacity get zero rows — they
+    fall through the residual connection untouched.
+    """
+    g, t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    # iterative top-k (k is small and static; avoids sort on [G,T,E])
+    dispatch = jnp.zeros((g, t, e, capacity), jnp.float32)
+    combine = jnp.zeros((g, t, e, capacity), jnp.float32)
+    remaining = probs
+    # running count of tokens already assigned to each expert: [G, E]
+    fill = jnp.zeros((g, e), jnp.int32)
+    for _ in range(top_k):
+        gate = remaining.max(axis=-1)                       # [G, T]
+        choice = remaining.argmax(axis=-1)                  # [G, T]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [G,T,E]
+        # position of each token within its chosen expert's buffer:
+        # cumulative count of earlier tokens choosing the same expert
+        # this round, plus what previous rounds already filled.
+        pos_in_round = (jnp.cumsum(onehot, axis=1) - onehot)  # [G,T,E]
+        pos = (pos_in_round + fill[:, None, :])               # [G,T,E]
+        pos_tok = jnp.einsum("gte,gte->gt", pos, onehot)      # [G,T]
+        keep = pos_tok < capacity
+        pos_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)            # [G,T,C]
+        slot = (onehot[..., None] * pos_oh[:, :, None, :]
+                * keep[:, :, None, None])                     # [G,T,E,C]
+        dispatch = dispatch + slot
+        combine = combine + slot * gate[:, :, None, None]
+        fill = fill + (onehot * keep[..., None]).sum(axis=1).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)  # mask this round's choice
+
+    # Switch-style aux loss on the FIRST-choice distribution:
+    # E * sum_e (fraction of tokens whose argmax is e) * (mean prob of e)
+    first = jax.nn.one_hot(probs.argmax(axis=-1), e, dtype=jnp.float32)
+    frac = first.mean(axis=(0, 1))
+    mean_p = probs.mean(axis=(0, 1))
+    aux = e * jnp.sum(frac * mean_p)
+
+    # renormalize kept gates so each token's surviving weights sum to 1
+    denom = combine.sum(axis=(2, 3), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux
+
+
+def moe_ffn(x: jax.Array, lp: dict, cfg: MoEConfig,
+            mesh: Mesh | None = None) -> tuple[jax.Array, jax.Array]:
+    """Routed SwiGLU FFN.  x: [B, T, d] → (out [B, T, d], aux_loss).
+
+    Dense one-hot algebra end to end: dispatch/combine are einsums, the
+    expert matmuls are a single batched ``[E, cap', d] @ [E, d, f]``
+    (vmapped over the stacked expert dim) — all MXU work.  The ``ep``
+    constraint on the dispatched tensor makes GSPMD materialize the
+    all-to-all token shuffle.
+    """
+    b_, t, d = x.shape
+    cap = cfg.capacity(t)
+    logits = x.astype(jnp.float32) @ lp["w_router"]          # [B,T,E]
+    dispatch, combine, aux = route_tokens(logits, cfg.top_k, cap)
+
+    # [B,T,E,C] × [B,T,d] → [E, B·C, d]: tokens grouped per expert
+    xd = jnp.einsum("gtec,gtd->egcd", dispatch.astype(x.dtype), x)
+    xd = xd.reshape(cfg.n_experts, b_ * cap, d)
+    xd = constrain(xd, mesh, "ep", ("dp", "fsdp"), None)
+
+    def expert(xe, wg, wu, wd):
+        h = jax.nn.silu(xe @ wg) * (xe @ wu)
+        return h @ wd
+
+    out = jax.vmap(expert)(xd, lp["w_gate"], lp["w_up"], lp["w_down"])
+    out = constrain(out, mesh, "ep", ("dp", "fsdp"), None)
+    out = out.reshape(cfg.n_experts, b_, cap, d)
+    y = jnp.einsum("egcd,gtec->gtd", out.astype(jnp.float32),
+                   combine).astype(x.dtype)
+    return y, aux
+
+
+# ---------------------------------------------------------------------------
+# Forward / loss
+# ---------------------------------------------------------------------------
+
+def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
+                mesh: Mesh | None = None) -> tuple[jax.Array, jax.Array]:
+    """tokens [B,T] → (logits [B,T,V] f32, total aux loss)."""
+    b = cfg.base
+    bs, t = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = constrain(x, mesh, ("dp", "fsdp"), "sp", None)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (bs, t))
+    attend = select_attend(b, mesh)
+
+    def layer(carry, lp):
+        x, aux_sum = carry
+        x = attention_sublayer(x, lp, b, positions, attend, mesh)
+        h = _rmsnorm(x, lp["mlp_norm"], b.norm_eps)
+        y, aux = moe_ffn(h, lp, cfg, mesh)
+        x = x + y
+        x = constrain(x, mesh, ("dp", "fsdp"), "sp", None)
+        return (x, aux_sum + aux), None
+
+    layer_fn = jax.checkpoint(layer) if b.remat else layer
+    (x, aux_sum), _ = jax.lax.scan(layer_fn, (x, jnp.float32(0.0)),
+                                   params["layers"])
+    x = _rmsnorm(x, params["final_norm"], b.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return constrain(logits, mesh, ("dp", "fsdp"), "sp", "tp"), aux_sum
+
+
+def moe_next_token_loss(params: dict, tokens: jax.Array, cfg: MoEConfig,
+                        mesh: Mesh | None = None) -> jax.Array:
+    logits, aux = moe_forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean() + cfg.router_aux_weight * aux / cfg.base.n_layers
+
+
+def make_moe_train_step(cfg: MoEConfig, optimizer,
+                        mesh: Mesh | None = None):
+    """(params, opt_state, tokens) → (params, opt_state, loss); the shared
+    llama train-step machinery with the MoE (lm + aux) loss."""
+    return make_train_step(cfg, optimizer, mesh,
+                           loss_fn=moe_next_token_loss)

@@ -52,7 +52,6 @@ class ScheduleResult:
 class _PendingGang:
     spec: GangSpec
     pods: dict[int, Pod] = field(default_factory=dict)   # index → pod
-    first_seen: float = field(default_factory=time.monotonic)
 
     def complete(self) -> bool:
         return len(self.pods) == self.spec.size
@@ -227,6 +226,13 @@ class DeviceScheduler:
         # forget incomplete-gang arrival times for gangs no longer pending
         self._gang_first_seen = {
             g: t for g, t in self._gang_first_seen.items() if g in gangs}
+        # start every incomplete gang's grace clock at first-member
+        # ARRIVAL, even while it waits behind a barrier — otherwise N
+        # trickling gangs serve their graces serially (N·grace head-of-
+        # line blocking instead of the documented per-gang bound)
+        for gname, pg in gangs.items():
+            if not pg.complete():
+                self._gang_first_seen.setdefault(gname, now)
 
         barrier: str | None = None  # incomplete gang blocking later units
         for kind, unit in units:
@@ -251,7 +257,7 @@ class DeviceScheduler:
             pg = gangs[gname]
             if not pg.complete():
                 result.held.extend(p.name for p in pg.pods.values())
-                first = self._gang_first_seen.setdefault(gname, now)
+                first = self._gang_first_seen.get(gname, now)
                 in_grace = now - first < self.gang_grace_s
                 self.trace.record("hold", gang=gname, detail={
                     "have": len(pg.pods), "want": pg.spec.size,

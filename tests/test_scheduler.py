@@ -193,7 +193,6 @@ class TestFifoFairness:
     def test_grace_expiry_unblocks_queue(self):
         """Grace 0: an incomplete gang never blocks — no deadlock when a
         gang member never shows up."""
-        import kubegpu_tpu.config as cfgmod
         from kubegpu_tpu.config import KubeTpuConfig
         cfg = KubeTpuConfig.load(overrides=[
             "backend.slice_types=v5e-16", "scheduler.gang_grace_s=0"])
