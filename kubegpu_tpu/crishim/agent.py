@@ -110,8 +110,18 @@ class NodeAgent:
                 self.handles[pod.name] = handle
                 self._uids[pod.name] = pod.metadata.uid
                 self._ns[pod.name] = pod.metadata.namespace
-                self.api.set_pod_phase(pod.name, PodPhase.RUNNING,
-                                       namespace=pod.metadata.namespace)
+                try:
+                    self.api.set_pod_phase(pod.name, PodPhase.RUNNING,
+                                           namespace=pod.metadata.namespace,
+                                           expect_uid=pod.metadata.uid)
+                except NotFound:
+                    # pod deleted (or evicted+recreated) between our list
+                    # and the phase write: this container must not outlive
+                    # its incarnation
+                    self.handles.pop(pod.name).kill()
+                    self._uids.pop(pod.name, None)
+                    self._ns.pop(pod.name, None)
+                    continue
                 started.append(handle)
         return started
 
@@ -129,13 +139,12 @@ class NodeAgent:
             phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
             ns = self._ns.get(pod_name, "default")
             try:
-                pod = self.api.get("Pod", pod_name, namespace=ns)
                 # only report for the incarnation this container belongs to
-                if pod.metadata.uid == self._uids.get(pod_name):
-                    self.api.set_pod_phase(
-                        pod_name, phase,
-                        message=handle.stderr[-2000:] if code else "",
-                        exit_code=code, namespace=ns)
+                self.api.set_pod_phase(
+                    pod_name, phase,
+                    message=handle.stderr[-2000:] if code else "",
+                    exit_code=code, namespace=ns,
+                    expect_uid=self._uids.get(pod_name))
             except NotFound:
                 pass
             del self.handles[pod_name]

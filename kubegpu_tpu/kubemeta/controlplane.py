@@ -147,12 +147,20 @@ class FakeApiServer:
 
     def set_pod_phase(self, name: str, phase, message: str = "",
                       exit_code: int | None = None,
-                      namespace: str = "default") -> None:
+                      namespace: str = "default",
+                      expect_uid: str | None = None) -> None:
+        """``expect_uid`` makes the write incarnation-safe: if the pod was
+        deleted and recreated under the same name (gang eviction) between
+        the caller's read and this write, the stale write is rejected as
+        NotFound instead of stamping the new pod's phase."""
         with self._lock:
             key = self._key(namespace, name)
             pod = self._stores["Pod"].objects.get(key)
             if pod is None:
                 raise NotFound(f"Pod {key}")
+            if expect_uid is not None and pod.metadata.uid != expect_uid:
+                raise NotFound(f"Pod {key} uid {pod.metadata.uid} != "
+                               f"{expect_uid} (recreated)")
             pod.status.phase = phase
             pod.status.message = message
             if exit_code is not None:

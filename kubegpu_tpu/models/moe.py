@@ -87,7 +87,7 @@ def moe_init(key: jax.Array, cfg: MoEConfig) -> dict:
         return (jax.random.normal(k, shape, jnp.float32)
                 * (scale_dim ** -0.5)).astype(b.jdtype)
 
-    ks = jax.random.split(k_layers, 9)
+    ks = jax.random.split(k_layers, 8)
     L, E = b.n_layers, cfg.n_experts
     layers = {
         "attn_norm": jnp.ones((L, b.d_model), b.jdtype),

@@ -9,6 +9,11 @@ locality model optimizes placement for.
 """
 
 from kubegpu_tpu.parallel.mesh import MeshAxes, make_mesh, mesh_axis_sizes
+from kubegpu_tpu.parallel.pipeline import (
+    make_pp_loss,
+    make_pp_train_step,
+    spmd_pipeline,
+)
 from kubegpu_tpu.parallel.ringattention import ring_attention
 from kubegpu_tpu.parallel.sharding import (
     constrain,
@@ -18,4 +23,5 @@ from kubegpu_tpu.parallel.sharding import (
 __all__ = [
     "MeshAxes", "make_mesh", "mesh_axis_sizes",
     "ring_attention", "constrain", "named_sharding_tree",
+    "spmd_pipeline", "make_pp_loss", "make_pp_train_step",
 ]

@@ -7,6 +7,8 @@ locality the allocator optimized is the locality the workload uses):
 - ``fsdp`` — data parallelism with sharded params (all-gather/reduce-scatter)
 - ``tp``   — tensor (megatron) parallelism (per-layer allreduce, hottest)
 - ``sp``   — sequence/context parallelism (ring attention neighbor exchange)
+- ``pp``   — pipeline parallelism (GPipe microbatches, ppermute hand-off)
+- ``ep``   — expert parallelism (MoE all-to-all dispatch/combine)
 """
 
 from __future__ import annotations
@@ -16,7 +18,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-MeshAxes = ("dp", "fsdp", "tp", "sp")
+MeshAxes = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
 
 def make_mesh(axis_sizes: dict[str, int],

@@ -1,0 +1,219 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+The reference has no parallelism code (SURVEY.md §3 — it *places* jobs);
+this module extends KubeTPU's TPU-native workload layer so gangs can use
+all of dp/tp/pp/sp/ep on their allocated slice.  Design is the SPMD
+"collective pipeline" of the scaling-book lineage, not a multi-program
+schedule:
+
+- the stacked-layer Llama params shard their leading ``[L, ...]`` dim on
+  ``pp`` — each stage holds ``L/S`` contiguous layers, so placement is
+  expressed purely as sharding (idiomatic GSPMD), and the stage body is
+  the same ``lax.scan`` the single-chip model runs;
+- microbatches stream through stages inside one ``lax.scan`` over
+  ``M + S - 1`` ticks; stage hand-off is a single ``ppermute`` to the next
+  ``pp`` rank (ICI neighbor traffic — the same pattern the allocator's
+  ring ordering optimizes);
+- tensor parallelism composes *inside* the stage via manual megatron
+  collectives (heads/ffn sharded on ``tp``, one ``psum`` after ``wo`` and
+  one after ``w_down``) because the stage body runs under ``shard_map``
+  where GSPMD constraints don't apply;
+- everything is differentiable (``scan`` + ``ppermute`` transpose), so
+  ``jax.grad`` of the pipelined loss gives the GPipe backward schedule
+  for free — no hand-written backward pass.
+
+Embedding and the LM head run replicated on every pp rank (stage 0
+consumes the embedding, the last stage the head); at 8B scale these would
+shard on tp/fsdp, which composes the same way.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubegpu_tpu.ops.flash_attention import xla_attention
+from kubegpu_tpu.parallel.sharding import fit_spec
+
+# NB: kubegpu_tpu.models.llama imports this package's sharding module, so
+# model-layer imports here must stay function-local to avoid a cycle.
+
+
+def spmd_pipeline(stage_fn, inputs_mb: jax.Array, n_stages: int,
+                  axis_name: str = "pp", remat: bool = False) -> jax.Array:
+    """Run the GPipe schedule under ``shard_map``.
+
+    ``inputs_mb`` is ``[M, ...]`` (M microbatches), identical on every
+    ``pp`` rank; ``stage_fn(x)`` applies this rank's stage to one
+    microbatch activation; ``n_stages`` is the (static) ``pp`` axis size.
+    Returns ``[M, ...]`` outputs that are valid on the LAST stage only
+    (zeros elsewhere — mask or ``psum`` to use them).
+
+    Tick ``t`` has stage ``s`` processing microbatch ``t - s``; ticks a
+    stage is idle for (pipeline bubble) compute garbage that the validity
+    select keeps out of both outputs and gradients.
+    """
+    stage = lax.axis_index(axis_name)
+    m = inputs_mb.shape[0]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def tick(carry, t):
+        act, outs = carry
+        x = jnp.where(stage == 0, inputs_mb[jnp.clip(t, 0, m - 1)], act)
+        y = body_fn(x)
+        oidx = t - (n_stages - 1)
+        upd = lax.dynamic_update_index_in_dim(
+            outs, y, jnp.clip(oidx, 0, m - 1), 0)
+        valid = (stage == n_stages - 1) & (oidx >= 0) & (oidx < m)
+        outs = jnp.where(valid, upd, outs)
+        act = lax.ppermute(y, axis_name, perm)
+        return (act, outs), None
+
+    zero = jnp.zeros(inputs_mb.shape[1:], inputs_mb.dtype)
+    outs0 = jnp.zeros_like(inputs_mb)
+    (_, outs), _ = lax.scan(
+        tick, (zero, outs0), jnp.arange(m + n_stages - 1))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Llama over (dp, pp, tp)
+# ---------------------------------------------------------------------------
+
+def llama_pp_param_specs(cfg) -> dict:
+    """PartitionSpec tree for the pipelined Llama: stacked-layer leading
+    dim on ``pp`` (contiguous L/S layers per stage), megatron ``tp`` on
+    head/ffn dims, embed/head replicated (see module docstring)."""
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P("pp", None),
+            "wq": P("pp", None, "tp"),
+            "wk": P("pp", None, "tp"),
+            "wv": P("pp", None, "tp"),
+            "wo": P("pp", "tp", None),
+            "mlp_norm": P("pp", None),
+            "w_gate": P("pp", None, "tp"),
+            "w_up": P("pp", None, "tp"),
+            "w_down": P("pp", "tp", None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, None),
+    }
+
+
+def _megatron_layer(x: jax.Array, lp: dict, positions: jax.Array,
+                    cfg, tp_axis: str | None) -> jax.Array:
+    """One decoder layer on tp-local shards: heads/ffn columns are local,
+    row-parallel matmuls produce partials resolved by one psum each."""
+    from kubegpu_tpu.models.llama import _rmsnorm, _rope
+
+    b, t = x.shape[0], x.shape[1]
+    hd = cfg.head_dim
+    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, t, -1, hd)
+    k = (h @ lp["wk"]).reshape(b, t, -1, hd)
+    v = (h @ lp["wv"]).reshape(b, t, -1, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    o = xla_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                      v.transpose(0, 2, 1, 3), causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
+    attn = o @ lp["wo"]
+    if tp_axis is not None:
+        attn = lax.psum(attn, tp_axis)
+    x = x + attn.astype(x.dtype)
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+    down = up @ lp["w_down"]
+    if tp_axis is not None:
+        down = lax.psum(down, tp_axis)
+    return x + down.astype(x.dtype)
+
+
+def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, n_microbatches: int):
+    """Build ``loss(params, tokens)``: the pipelined next-token loss over
+    ``mesh`` (axes ⊆ {dp, pp, tp}), jit-ready.  Matches
+    :func:`kubegpu_tpu.models.llama.next_token_loss` numerically when the
+    microbatch split is even (same per-token mean).
+    """
+    from kubegpu_tpu.models.llama import _rmsnorm
+
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if "pp" in axes:
+        pp = axes["pp"]
+    else:
+        raise ValueError(
+            f"mesh {axes} has no 'pp' axis (size-1 is fine)")
+    tp = axes.get("tp", 1)
+    tp_axis = "tp" if tp > 1 else None
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers {cfg.n_layers} % pp {pp} != 0")
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"heads ({cfg.n_heads}/{cfg.n_kv_heads}) must divide tp {tp}")
+
+    pspecs = jax.tree.map(lambda s: fit_spec(mesh, s),
+                          llama_pp_param_specs(cfg),
+                          is_leaf=lambda x: isinstance(x, P))
+    tok_spec = fit_spec(mesh, P("dp", None))
+
+    def local_loss(params, tokens):
+        # tokens: dp-local [b, T+1]
+        b, t1 = tokens.shape
+        if b % n_microbatches:
+            raise ValueError(
+                f"local batch {b} % microbatches {n_microbatches} != 0")
+        mb = b // n_microbatches
+        t = t1 - 1
+        inp = tokens[:, :-1].reshape(n_microbatches, mb, t)
+        tgt = tokens[:, 1:].reshape(n_microbatches, mb, t)
+        x = jnp.take(params["embed"], inp, axis=0)      # [M, mb, T, d]
+        positions = jnp.broadcast_to(
+            jnp.arange(t, dtype=jnp.int32), (mb, t))
+
+        def stage(x_mb):
+            def layer(x, lp):
+                return _megatron_layer(x, lp, positions, cfg,
+                                       tp_axis), None
+            x_mb, _ = lax.scan(layer, x_mb, params["layers"])
+            return x_mb
+
+        outs = spmd_pipeline(stage, x, n_stages=pp, axis_name="pp",
+                             remat=cfg.remat)
+        h = _rmsnorm(outs, params["final_norm"], cfg.norm_eps)
+        logits = (h @ params["lm_head"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        loss = -ll.mean()
+        # outputs (hence loss) are valid on the last pp rank only
+        loss = lax.psum(
+            jnp.where(lax.axis_index("pp") == pp - 1, loss, 0.0), "pp")
+        if "dp" in mesh.axis_names:
+            loss = lax.pmean(loss, "dp")
+        return loss
+
+    return jax.shard_map(
+        local_loss, mesh=mesh, in_specs=(pspecs, tok_spec),
+        out_specs=P(), check_vma=False)
+
+
+def make_pp_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
+                       n_microbatches: int = 2):
+    """(params, opt_state, tokens) → (params, opt_state, loss) with the
+    pipelined loss; same contract as
+    :func:`kubegpu_tpu.models.llama.make_train_step`."""
+    import optax
+
+    loss_fn = make_pp_loss(cfg, mesh, n_microbatches)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
