@@ -148,6 +148,22 @@ class SliceState:
                 st.bad_links.add((min(pair), max(pair)))
         return st
 
+    def clone(self) -> "SliceState":
+        """Copy for what-if planning (preemption/backfill trials): mutable
+        occupancy/health is copied, immutable topo/spec shared."""
+        st = SliceState.__new__(SliceState)
+        st.slice_id = self.slice_id
+        st.spec = self.spec
+        st.topo = self.topo
+        st.node_of_host = dict(self.node_of_host)
+        st.ip_of_host = dict(self.ip_of_host)
+        st.available = set(self.available)
+        st.unhealthy = set(self.unhealthy)
+        st.bad_links = set(self.bad_links)
+        st.local_index = dict(self.local_index)
+        st.used_millichips = dict(self.used_millichips)
+        return st
+
     # -- occupancy -------------------------------------------------------
 
     def blocked_for_whole(self) -> set[Coord]:

@@ -42,7 +42,8 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
             gang: GangSpec | None = None,
             mesh_axes: dict[str, int] | None = None,
             command: list[str] | None = None,
-            env: dict[str, str] | None = None) -> Pod:
+            env: dict[str, str] | None = None,
+            priority: int = 0) -> Pod:
     """Pod-spec builder — the user surface (reference: example/ YAML)."""
     pod = Pod(
         metadata=ObjectMeta(name=name),
@@ -51,7 +52,7 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
             command=command or [],
             env=env or {},
             resources=ResourceRequests(tpu_chips=chips, millitpu=millitpu),
-        )]),
+        )], priority=priority),
     )
     if gang is not None:
         set_pod_gang(pod, gang)

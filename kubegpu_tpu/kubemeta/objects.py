@@ -96,6 +96,9 @@ class PodSpec:
     containers: list[ContainerSpec] = field(default_factory=list)
     node_name: str | None = None   # set at bind time
     scheduler_name: str = "kubetpu-scheduler"
+    # k8s pod.spec.priority equivalent: higher schedules first and may
+    # preempt committed lower-priority gangs (gang priority = max member)
+    priority: int = 0
 
     @property
     def total_chips(self) -> int:

@@ -14,6 +14,18 @@ partially placed) until every member has arrived and a whole-gang
 assignment exists; then all members are committed/bound in one step.
 No partial placement ⇒ no gang-vs-gang deadlock; FIFO with skip ⇒ no
 head-of-line blocking.
+
+Queue policy (k8s scheduler semantics, TPU-gang flavored):
+- **priority**: units order by (priority desc, arrival); a unit with
+  higher priority than an in-grace incomplete gang bypasses its barrier;
+- **preemption**: a gang that doesn't fit may evict committed gangs of
+  strictly lower priority — planned on cloned slice states (greedy evict
+  lowest-priority-first, then minimized so no needless victim), victims
+  requeued whole (gang semantics: members must restart together);
+- **backfill**: while an incomplete gang holds the barrier, a later unit
+  may still schedule if a what-if trial shows the barrier gang's
+  projected request STILL fits after the unit is placed (conservative
+  backfill — the blocked gang never loses its spot).
 """
 
 from __future__ import annotations
@@ -56,6 +68,10 @@ class _PendingGang:
     def complete(self) -> bool:
         return len(self.pods) == self.spec.size
 
+    @property
+    def priority(self) -> int:
+        return max((p.spec.priority for p in self.pods.values()), default=0)
+
 
 class DeviceScheduler:
     def __init__(self, api: FakeApiServer,
@@ -77,6 +93,7 @@ class DeviceScheduler:
         self.slices: dict[str, SliceState] = {}
         self._committed: dict[str, GangAssignment] = {}  # gang → assignment
         self._pod_gang: dict[str, str] = {}              # pod name → gang
+        self._gang_priority: dict[str, int] = {}         # committed gangs
         self._gang_first_seen: dict[str, float] = {}     # incomplete gangs
         self.sync()
 
@@ -100,6 +117,7 @@ class DeviceScheduler:
         }
         self._committed.clear()
         self._pod_gang.clear()
+        self._gang_priority.clear()
         gang_pods: dict[str, list] = {}
         for pod in self.api.list("Pod"):
             if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
@@ -111,6 +129,9 @@ class DeviceScheduler:
                 self.slices[alloc.slice_id].take(alloc.chips)
             gang = alloc.gang_name or pod.name
             self._pod_gang[pod.name] = gang
+            self._gang_priority[gang] = max(
+                self._gang_priority.get(gang, pod.spec.priority),
+                pod.spec.priority)
             gang_pods.setdefault(gang, []).append(alloc)
         # Rebuild committed assignments from annotation truth so later
         # completions release chips even across scheduler restarts/re-syncs.
@@ -197,14 +218,18 @@ class DeviceScheduler:
         """One pass over pending pods: group into gangs, place complete
         gangs atomically, write allocation annotations, bind.
 
-        Units (singles and complete gangs) are scheduled in FIFO arrival
-        order — a gang's place in line is its FIRST member's arrival — so
-        a late single can't grab the chip that blocks a gang which was
-        queued ahead of it (fractional pods fragmenting a slice ahead of a
-        whole-slice gang was the observed failure).  An INCOMPLETE gang at
-        the head additionally blocks later units for ``gang_grace_s``
-        after its first member arrived; when the grace expires, later
-        units flow again (deadlock-free work conservation)."""
+        Units (singles and complete gangs) are scheduled in (priority
+        desc, FIFO arrival) order — a gang's place in line is its FIRST
+        member's arrival — so a late single can't grab the chip that
+        blocks a gang which was queued ahead of it (fractional pods
+        fragmenting a slice ahead of a whole-slice gang was the observed
+        failure).  An INCOMPLETE gang at the head additionally blocks
+        later units of its priority and below for ``gang_grace_s`` after
+        its first member arrived — unless a what-if trial shows the unit
+        can be *backfilled* without hurting ANY earlier-queued held
+        unit's fit (in-grace gangs and backfill-denied units alike);
+        when the grace expires, later units flow unconditionally again
+        (deadlock-free work conservation)."""
         result = ScheduleResult()
         now = time.monotonic()
         pending = [p for p in self.api.list("Pod")
@@ -234,16 +259,51 @@ class DeviceScheduler:
             if not pg.complete():
                 self._gang_first_seen.setdefault(gname, now)
 
+        def unit_priority(kind: str, unit) -> int:
+            return (unit.spec.priority if kind == "single"
+                    else gangs[unit].priority)
+
+        # stable sort: priority desc, FIFO within equal priority
+        units.sort(key=lambda ku: -unit_priority(*ku))
+
         barrier: str | None = None  # incomplete gang blocking later units
+        protected: list[GangRequest] = []  # held units' asks, queue order
         for kind, unit in units:
-            if barrier is not None:
-                names = ([unit.name] if kind == "single" else
-                         [p.name for p in gangs[unit].pods.values()])
-                result.held.extend(names)
-                self.trace.record("defer", gang=unit if kind == "gang"
-                                  else unit.name,
-                                  detail={"behind": barrier})
+            if kind == "gang" and not gangs[unit].complete():
+                gname, pg = unit, gangs[unit]
+                result.held.extend(p.name for p in pg.pods.values())
+                first = self._gang_first_seen.get(gname, now)
+                in_grace = now - first < self.gang_grace_s
+                self.trace.record("hold", gang=gname, detail={
+                    "have": len(pg.pods), "want": pg.spec.size,
+                    "blocking": in_grace and barrier is None})
+                if in_grace:
+                    # in-grace gangs (head barrier AND later ones) keep
+                    # their claim: later units must not steal their fit
+                    if barrier is None:
+                        barrier = gname
+                    preq = self._projected_request(pg)
+                    if preq is not None:
+                        protected.append(preq)
                 continue
+            if barrier is not None:
+                allowed, ureq = self._may_backfill(kind, unit, gangs,
+                                                   protected)
+                if not allowed:
+                    names = ([unit.name] if kind == "single" else
+                             [p.name for p in gangs[unit].pods.values()])
+                    result.held.extend(names)
+                    if ureq is not None:
+                        # a held unit's ask is protected from LATER
+                        # backfillers too — queue order is preserved
+                        protected.append(ureq)
+                    self.trace.record("defer", gang=unit if kind == "gang"
+                                      else unit.name,
+                                      detail={"behind": barrier})
+                    continue
+                self.trace.record("backfill", gang=unit if kind == "gang"
+                                  else unit.name,
+                                  detail={"past": barrier})
             if kind == "single":
                 pod = unit
                 try:
@@ -251,20 +311,11 @@ class DeviceScheduler:
                 except ValueError as e:
                     self._reject(pod.name, [pod], str(e), result)
                     continue
-                self._schedule_gang(pod.name, [pod], req, result)
+                self._schedule_gang(pod.name, [pod], req, result,
+                                    priority=pod.spec.priority)
                 continue
             gname = unit
             pg = gangs[gname]
-            if not pg.complete():
-                result.held.extend(p.name for p in pg.pods.values())
-                first = self._gang_first_seen.get(gname, now)
-                in_grace = now - first < self.gang_grace_s
-                self.trace.record("hold", gang=gname, detail={
-                    "have": len(pg.pods), "want": pg.spec.size,
-                    "blocking": in_grace})
-                if in_grace:
-                    barrier = gname
-                continue
             self._gang_first_seen.pop(gname, None)
             members = [pg.pods[i] for i in range(pg.spec.size)]
             try:
@@ -272,8 +323,75 @@ class DeviceScheduler:
             except ValueError as e:
                 self._reject(gname, members, str(e), result)
                 continue
-            self._schedule_gang(gname, members, req, result)
+            self._schedule_gang(gname, members, req, result,
+                                priority=pg.priority)
         return result
+
+    # ------------------------------------------------------------------
+    # Backfill (what-if trials on cloned slice states)
+    # ------------------------------------------------------------------
+
+    def _projected_request(self, pg: _PendingGang) -> GangRequest | None:
+        """The request an incomplete gang WILL make once complete, shaped
+        from its arrived members (gangs are homogeneous by contract)."""
+        member = next(iter(pg.pods.values()))
+        chips = member.spec.total_chips
+        try:
+            return GangRequest(
+                gang_name=pg.spec.name,
+                num_pods=pg.spec.size,
+                chips_per_pod=chips,
+                millitpu_per_pod=member.spec.total_millitpu,
+                mesh_axes=self._sane_axes(pod_mesh_axes(member),
+                                          pg.spec.size * chips))
+        except ValueError:
+            return None
+
+    def _may_backfill(self, kind: str, unit, gangs: dict,
+                      protected: list[GangRequest]
+                      ) -> tuple[bool, GangRequest | None]:
+        """Conservative backfill past the in-grace barrier: the unit may
+        schedule iff a what-if trial shows every EARLIER-QUEUED held
+        unit's request that fits today still fits after the unit is
+        placed (requests are committed sequentially in queue order on
+        both sides of the comparison).  Returns (allowed, request): the
+        request comes back only when the unit is denied, so the caller
+        can protect it from later backfillers in turn.  0-device units
+        always pass (no TPU contention)."""
+        try:
+            if kind == "single":
+                req = self._request_for_single(unit)
+            else:
+                pg = gangs[unit]
+                req = self._request_for_gang(
+                    unit, [pg.pods[i] for i in range(pg.spec.size)])
+        except ValueError:
+            return True, None   # rejected downstream; no resource risk
+        if req.total_chips == 0 and req.millitpu_per_pod == 0:
+            return True, None
+        # find_assignment is read-only, so probe placement on the real
+        # state first and clone only if the what-if comparison is needed
+        asg = self.allocator.find_assignment(list(self.slices.values()), req)
+        if asg is None:
+            return False, req  # can't place now; held (not failed), and
+            #                    protected so later units can't leapfrog
+        if not protected:
+            return True, None
+        after = {sid: st.clone() for sid, st in self.slices.items()}
+        self.allocator.commit(after, asg)
+        before = {sid: st.clone() for sid, st in self.slices.items()}
+        for preq in protected:
+            a_before = self.allocator.find_assignment(
+                list(before.values()), preq)
+            if a_before is None:
+                continue   # doesn't fit today anyway; can't be hurt
+            self.allocator.commit(before, a_before)
+            a_after = self.allocator.find_assignment(
+                list(after.values()), preq)
+            if a_after is None:
+                return False, req
+            self.allocator.commit(after, a_after)
+        return True, None
 
     def _reject(self, gang: str, members: list[Pod], reason: str,
                 result: ScheduleResult) -> None:
@@ -284,7 +402,8 @@ class DeviceScheduler:
         self.trace.record("invalid", gang=gang, detail={"reason": reason})
 
     def _schedule_gang(self, gang_name: str, members: list[Pod],
-                       req: GangRequest, result: ScheduleResult) -> None:
+                       req: GangRequest, result: ScheduleResult,
+                       priority: int = 0) -> None:
         t0 = time.perf_counter()
         # 0-device pods (CPU fallback, BASELINE config 1): bind to any
         # ready node, TPU-bearing or not.
@@ -302,6 +421,19 @@ class DeviceScheduler:
             return
 
         asg = self.allocator.find_assignment(list(self.slices.values()), req)
+        preemptible = any(p < priority for p in self._gang_priority.values())
+        if asg is None and preemptible:
+            victims = self._plan_preemption(req, priority)
+            if victims:
+                for victim in victims:
+                    self.metrics.inc("gangs_preempted")
+                    self.evict_gang(
+                        victim,
+                        f"preempted by {gang_name} "
+                        f"(priority {priority} > "
+                        f"{self._gang_priority.get(victim, 0)})")
+                asg = self.allocator.find_assignment(
+                    list(self.slices.values()), req)
         if asg is None:
             result.unschedulable.extend(p.name for p in members)
             self.metrics.inc("schedule_unschedulable")
@@ -315,6 +447,7 @@ class DeviceScheduler:
         allocations = asg.to_allocations(coordinator, hostnames)
         self.allocator.commit(self.slices, asg)
         self._committed[gang_name] = asg
+        self._gang_priority[gang_name] = priority
         for pod, alloc in zip(members, allocations):
             alloc.gang_name = gang_name
             self._pod_gang[pod.name] = gang_name
@@ -349,11 +482,114 @@ class DeviceScheduler:
         # release only when the last member of the gang is gone
         if any(g == gang for g in self._pod_gang.values()):
             return
+        self._gang_priority.pop(gang, None)
         asg = self._committed.pop(gang, None)
         if asg is not None and asg.slice_id in self.slices:
             self.allocator.rollback(self.slices, asg)
             self.trace.record("release", gang=gang,
                               detail={"slice": asg.slice_id})
+
+    # ------------------------------------------------------------------
+    # Preemption + eviction (shared with the fault-recovery controller)
+    # ------------------------------------------------------------------
+
+    def _plan_preemption(self, req: GangRequest,
+                         priority: int) -> list[str] | None:
+        """Pick victim gangs (strictly lower priority) whose eviction lets
+        ``req`` fit — planned entirely on cloned slice states.  Greedy:
+        evict lowest-priority first (newest commit breaks ties, k8s-style
+        'youngest victim'), then a minimization pass re-admits any victim
+        the fit doesn't actually need.  Returns None when no eviction set
+        works (then nobody is evicted — no pointless thrash)."""
+        order = sorted(
+            (g for g in self._committed
+             if self._gang_priority.get(g, 0) < priority),
+            key=lambda g: (self._gang_priority.get(g, 0),
+                           -list(self._committed).index(g)))
+        if not order:
+            return None
+        trial = {sid: st.clone() for sid, st in self.slices.items()}
+        chosen: list[str] = []
+        fits = False
+        for victim in order:
+            asg = self._committed[victim]
+            if asg.slice_id not in trial:
+                continue   # slice gone; eviction frees nothing here
+            self.allocator.rollback(trial, asg)
+            chosen.append(victim)
+            if self.allocator.find_assignment(
+                    list(trial.values()), req) is not None:
+                fits = True
+                break
+        if not fits:
+            return None
+        # minimize: re-admit victims the placement doesn't actually need
+        for victim in list(chosen):
+            asg = self._committed[victim]
+            self.allocator.commit(trial, asg)
+            if self.allocator.find_assignment(
+                    list(trial.values()), req) is None:
+                self.allocator.rollback(trial, asg)   # still required
+            else:
+                chosen.remove(victim)
+        return chosen
+
+    def gang_member_pods(self, gang: str) -> list[Pod]:
+        """LIVE members identified by their allocation's gang name
+        (annotation truth) — never by bare pod name, which can collide
+        across namespaces.  Terminal pods are excluded: a completed member
+        keeps its allocation annotation, and evicting it would silently
+        resurrect and re-run a finished workload."""
+        from kubegpu_tpu.kubemeta import pod_allocation
+        out = []
+        for p in self.api.list("Pod"):
+            if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            alloc = pod_allocation(p)
+            if alloc is not None and (alloc.gang_name or p.name) == gang:
+                out.append(p)
+        return out
+
+    def evict_gang(self, gang: str, reason: str) -> list[str]:
+        """Whole-gang eviction + requeue (used by preemption here and by
+        the fault-recovery controller): delete every live member (kills
+        containers via node-agent reconcile, frees chips via the
+        return-resources path), then recreate identical PENDING pods —
+        same name/spec/gang, no binding, no allocation annotation — so the
+        next pass schedules the gang fresh.  Returns requeued pod names."""
+        from kubegpu_tpu.kubemeta import NotFound
+        from kubegpu_tpu.kubemeta.objects import ObjectMeta, PodStatus
+
+        pods = self.gang_member_pods(gang)
+        self.trace.record("evict", gang=gang, detail={
+            "reason": reason, "pods": sorted(p.name for p in pods)})
+        for pod in pods:
+            try:
+                self.api.delete("Pod", pod.name,
+                                namespace=pod.metadata.namespace)
+            except NotFound:
+                pass
+            # Belt-and-braces: free chips even when no lifecycle wiring
+            # (e.g. scheduler used standalone in tests) — idempotent, the
+            # first call pops the pod from the gang map.
+            self.return_pod_resources(pod.name)
+        requeued: list[str] = []
+        for pod in pods:
+            annotations = {k: v for k, v in pod.metadata.annotations.items()
+                           if k != ALLOCATE_FROM_KEY}
+            fresh = Pod(
+                metadata=ObjectMeta(
+                    name=pod.metadata.name,
+                    namespace=pod.metadata.namespace,
+                    labels=dict(pod.metadata.labels),
+                    annotations=annotations),
+                spec=pod.spec,
+                status=PodStatus(phase=PodPhase.PENDING,
+                                 message=f"requeued: {reason}"))
+            fresh.spec.node_name = None
+            self.api.create("Pod", fresh)
+            requeued.append(fresh.name)
+        return requeued
 
     # ------------------------------------------------------------------
     # Request construction

@@ -27,10 +27,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from kubegpu_tpu.allocator.gang import GangAssignment, SliceState
-from kubegpu_tpu.kubemeta import FakeApiServer, NotFound, Pod, PodPhase
-from kubegpu_tpu.kubemeta.codec import ALLOCATE_FROM_KEY
+from kubegpu_tpu.kubemeta import FakeApiServer, Pod, PodPhase
 from kubegpu_tpu.kubemeta.controlplane import WatchEvent
-from kubegpu_tpu.kubemeta.objects import ObjectMeta, PodStatus
 from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
 from kubegpu_tpu.scheduler.extender import DeviceScheduler
 
@@ -96,7 +94,7 @@ class FaultRecoveryController:
                 self.trace.record("degraded", gang=gang,
                                   detail={"reason": reason})
                 continue
-            self._evict_gang(gang, asg, reason, result)
+            self._evict_gang(gang, reason, result)
         if result.evicted_gangs:
             # Eviction released chips; the queue sees the pods next pass.
             self.metrics.inc("gangs_evicted", len(result.evicted_gangs))
@@ -164,52 +162,11 @@ class FaultRecoveryController:
         return (alt.slice_id, new) != (asg.slice_id, cur)
 
     def _gang_member_pods(self, gang: str) -> list[Pod]:
-        """LIVE members identified by their allocation's gang name
-        (annotation truth) — never by bare pod name, which can collide
-        across namespaces.  Terminal pods are excluded: a completed member
-        keeps its allocation annotation, and evicting it would silently
-        resurrect and re-run a finished workload."""
-        from kubegpu_tpu.kubemeta import pod_allocation
-        out = []
-        for p in self.api.list("Pod"):
-            if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
-                continue
-            alloc = pod_allocation(p)
-            if alloc is not None and (alloc.gang_name or p.name) == gang:
-                out.append(p)
-        return out
+        return self.scheduler.gang_member_pods(gang)
 
-    def _evict_gang(self, gang: str, asg: GangAssignment, reason: str,
+    def _evict_gang(self, gang: str, reason: str,
                     result: RecoveryResult) -> None:
-        pods = self._gang_member_pods(gang)
-        self.trace.record("evict", gang=gang, detail={
-            "reason": reason, "pods": sorted(p.name for p in pods)})
-        # Delete first (kills containers via node-agent reconcile, frees the
-        # allocation via the scheduler's return-resources path), then
-        # recreate pending replacements.
-        for pod in pods:
-            try:
-                self.api.delete("Pod", pod.name,
-                                namespace=pod.metadata.namespace)
-            except NotFound:
-                pass
-            # Belt-and-braces: free chips even when no lifecycle wiring
-            # (e.g. controller used standalone in tests) — idempotent, the
-            # scheduler pops the pod from its gang map on first call.
-            self.scheduler.return_pod_resources(pod.name)
-        for pod in pods:
-            annotations = {k: v for k, v in pod.metadata.annotations.items()
-                           if k != ALLOCATE_FROM_KEY}
-            fresh = Pod(
-                metadata=ObjectMeta(
-                    name=pod.metadata.name,
-                    namespace=pod.metadata.namespace,
-                    labels=dict(pod.metadata.labels),
-                    annotations=annotations),
-                spec=pod.spec,
-                status=PodStatus(phase=PodPhase.PENDING,
-                                 message=f"requeued: {reason}"))
-            fresh.spec.node_name = None
-            self.api.create("Pod", fresh)
-            result.requeued_pods.append(fresh.name)
+        # Delete-first + recreate-pending lives on the scheduler (shared
+        # with priority preemption).
+        result.requeued_pods.extend(self.scheduler.evict_gang(gang, reason))
         result.evicted_gangs[gang] = reason

@@ -205,3 +205,179 @@ class TestFifoFairness:
         assert "solo" in result.scheduled      # flowed past the held gang
         assert "g-0" in result.held
         cl.close()
+
+
+class TestPriorityPreemptionBackfill:
+    def test_priority_orders_queue(self):
+        """Higher priority schedules first even when submitted later."""
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("low", chips=4, command=["x"], priority=0))
+        cl.submit(tpu_pod("high", chips=4, command=["x"], priority=5))
+        result, _ = cl.step()
+        # v4-8 has 4 chips — only one of the two fits
+        assert result.scheduled == ["high"]
+        assert "low" in result.unschedulable
+        cl.close()
+
+    def test_preemption_evicts_lower_priority_gang(self):
+        cl = SimCluster(["v4-8"])
+        cl.submit(*[
+            tpu_pod(f"low-{i}", chips=1,
+                    gang=GangSpec(name="low", size=4, index=i),
+                    command=["x"], priority=0)
+            for i in range(4)
+        ])
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4
+        # high-priority gang needs the whole slice → must preempt
+        cl.submit(*[
+            tpu_pod(f"hi-{i}", chips=2,
+                    gang=GangSpec(name="hi", size=2, index=i),
+                    command=["x"], priority=10)
+            for i in range(2)
+        ])
+        result, _ = cl.step()
+        assert set(result.scheduled) == {"hi-0", "hi-1"}
+        # victims were requeued whole as fresh PENDING pods
+        for i in range(4):
+            assert cl.pod_phase(f"low-{i}") == PodPhase.PENDING
+        assert cl.metrics.snapshot()["counters"]["gangs_preempted"] == 1.0
+        cl.close()
+
+    def test_no_preemption_among_equal_priority(self):
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("first", chips=4, command=["x"], priority=3))
+        cl.step()
+        cl.submit(tpu_pod("second", chips=4, command=["x"], priority=3))
+        result, _ = cl.step()
+        assert "second" in result.unschedulable
+        assert cl.pod_phase("first") != PodPhase.PENDING
+        cl.close()
+
+    def test_preemption_minimizes_victims(self):
+        """Evict exactly as many victims as the fit needs, no more."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(tpu_pod("a", chips=4, command=["x"], priority=0))
+        cl.submit(tpu_pod("b", chips=4, command=["x"], priority=0))
+        result, _ = cl.step()
+        assert len(result.scheduled) == 2
+        # 8 free; asking 12 (3 host-local pods) ⇒ exactly one victim goes
+        cl.submit(*[
+            tpu_pod(f"big-{i}", chips=4,
+                    gang=GangSpec(name="big", size=3, index=i),
+                    command=["x"], priority=7)
+            for i in range(3)
+        ])
+        result, _ = cl.step()
+        assert set(result.scheduled) == {f"big-{i}" for i in range(3)}
+        phases = {n: cl.pod_phase(n) for n in ("a", "b")}
+        assert sorted(p == PodPhase.PENDING for p in phases.values()) \
+            == [False, True], phases
+        cl.close()
+
+    def test_backfill_past_incomplete_gang(self):
+        """A later single schedules during the barrier grace when the
+        what-if trial shows the gang still fits afterwards."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(*[
+            tpu_pod(f"g-{i}", chips=2,
+                    gang=GangSpec(name="g", size=4, index=i),
+                    command=["x"])
+            for i in range(3)  # 8 chips once complete; member 3 late
+        ])
+        cl.submit(tpu_pod("solo", chips=4, command=["x"]))
+        result, _ = cl.step()
+        assert "solo" in result.scheduled          # backfilled
+        assert "g-0" in result.held
+        cl.submit(tpu_pod("g-3", chips=2,
+                          gang=GangSpec(name="g", size=4, index=3),
+                          command=["x"]))
+        result, _ = cl.step()
+        assert set(result.scheduled) == {f"g-{i}" for i in range(4)}
+        cl.close()
+
+    def test_backfill_denied_when_it_would_hurt_the_gang(self):
+        """The conservative check: a single whose placement would break
+        the blocked gang's fit stays held (the pre-backfill behavior)."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(*[
+            tpu_pod(f"g-{i}", chips=4,
+                    gang=GangSpec(name="g", size=4, index=i),
+                    command=["x"])
+            for i in range(3)  # whole slice once complete
+        ])
+        cl.submit(tpu_pod("solo", chips=1, command=["x"]))
+        result, _ = cl.step()
+        assert result.scheduled == []
+        assert "solo" in result.held
+        cl.close()
+
+    def test_high_priority_bypasses_barrier(self):
+        """Priority ordering puts a high-priority unit ahead of the
+        in-grace incomplete gang entirely."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(*[
+            tpu_pod(f"g-{i}", chips=4,
+                    gang=GangSpec(name="g", size=4, index=i),
+                    command=["x"], priority=0)
+            for i in range(3)
+        ])
+        # would be denied backfill (takes the whole slice) but outranks
+        cl.submit(*[
+            tpu_pod(f"urgent-{i}", chips=4,
+                    gang=GangSpec(name="urgent", size=4, index=i),
+                    command=["x"], priority=9)
+            for i in range(4)
+        ])
+        result, _ = cl.step()
+        assert set(result.scheduled) == {f"urgent-{i}" for i in range(4)}
+        cl.close()
+
+    def test_preempted_gang_comes_back_after_release(self):
+        """The full cycle: preempted → pending → high-pri job finishes →
+        victim reschedules."""
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("low", chips=4, command=["x"], priority=0))
+        cl.step()
+        cl.submit(tpu_pod("hi", chips=4, command=["x"], priority=5))
+        cl.step()
+        assert cl.pod_phase("low") == PodPhase.PENDING
+        # hi's container finishes (FakeRuntime exits 0 immediately on reap)
+        cl.reap(timeout=0)
+        result, _ = cl.step()
+        assert "low" in result.scheduled
+        cl.close()
+
+    def test_backfill_protects_all_held_units_not_just_barrier(self):
+        """Review regression: with gang A (barrier, small ask) and gang B
+        (second in-grace gang, big ask) both held, a later single must
+        not steal the chips B needs just because A's fit survives."""
+        cl = SimCluster(["v5e-16"])
+        # A: incomplete, projected 2 pods x 2 chips = 4 chips
+        cl.submit(tpu_pod("a-0", chips=2,
+                          gang=GangSpec(name="a", size=2, index=0),
+                          command=["x"]))
+        # B: incomplete, projected 3 pods x 4 chips = 12 chips
+        cl.submit(*[
+            tpu_pod(f"b-{i}", chips=4,
+                    gang=GangSpec(name="b", size=3, index=i),
+                    command=["x"])
+            for i in range(2)
+        ])
+        # C: later single asking 4 chips — A (4) still fits after C (4),
+        # but A + B (16) would not; C must be held
+        cl.submit(tpu_pod("c", chips=4, command=["x"]))
+        result, _ = cl.step()
+        assert result.scheduled == []
+        assert "c" in result.held
+        # stragglers arrive: both gangs schedule, then C fails (full)
+        cl.submit(tpu_pod("a-1", chips=2,
+                          gang=GangSpec(name="a", size=2, index=1),
+                          command=["x"]))
+        cl.submit(tpu_pod("b-2", chips=4,
+                          gang=GangSpec(name="b", size=3, index=2),
+                          command=["x"]))
+        result, _ = cl.step()
+        scheduled = set(result.scheduled)
+        assert {"a-0", "a-1", "b-0", "b-1", "b-2"} <= scheduled
+        cl.close()
