@@ -61,9 +61,11 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
             axes = {str(k): int(v) for k, v in axes.items()}
         command = [str(c) for c in entry.get("command", [])]
         env = {str(k): str(v) for k, v in (entry.get("env") or {}).items()}
+        priority = int(entry.get("priority", 0))
         if gang is None:
             pods.append(tpu_pod(name, chips=chips, millitpu=millitpu,
-                                mesh_axes=axes, command=command, env=env))
+                                mesh_axes=axes, command=command, env=env,
+                                priority=priority))
             continue
         if isinstance(gang, int):
             gang = {"size": gang}
@@ -73,7 +75,8 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
             pods.append(tpu_pod(
                 f"{name}-{i}", chips=chips, millitpu=millitpu,
                 gang=GangSpec(name=gname, size=size, index=i),
-                mesh_axes=axes, command=command, env=env))
+                mesh_axes=axes, command=command, env=env,
+                priority=priority))
     return pods, slices
 
 
