@@ -1,7 +1,14 @@
 """Model families for the workload layer (reference: example/ specs'
-training programs).  Llama (pure JAX, pjit/GSPMD-sharded, the flagship),
-ResNet-50 (flax), and the MNIST MLP (inside workloads/programs)."""
+training programs).  Llama (pure JAX, pjit/GSPMD-sharded, the flagship)
+with a KV-cache serving path, Mixtral-style MoE, ResNet-50 (flax), and
+the MNIST MLP (inside workloads/programs)."""
 
+from kubegpu_tpu.models.decode import (
+    decode_step,
+    greedy_generate,
+    init_kv_cache,
+    prefill,
+)
 from kubegpu_tpu.models.llama import (
     LlamaConfig,
     llama_forward,
@@ -18,4 +25,5 @@ from kubegpu_tpu.models.moe import (
 __all__ = [
     "LlamaConfig", "llama_forward", "llama_init", "llama_param_specs",
     "MoEConfig", "moe_forward", "moe_init", "moe_param_specs",
+    "init_kv_cache", "prefill", "decode_step", "greedy_generate",
 ]

@@ -1,0 +1,157 @@
+"""Autoregressive serving path for the Llama family: KV-cache prefill +
+single-token decode, both jit-compiled with static shapes.
+
+The reference schedules training jobs and has no serving stack; this is
+the TPU-native inference complement to :mod:`kubegpu_tpu.models.llama`
+(same stacked-layer params, same rope/rmsnorm/GQA math), built the way
+XLA wants a decode loop:
+
+- the cache is a stacked ``[L, B, Hkv, max_len, hd]`` pair preallocated
+  once — decode writes slot ``pos`` with ``dynamic_update_slice`` and
+  never reshapes, so every step hits the same compiled executable;
+- attention always spans the full ``max_len`` with an explicit
+  ``k_pos <= q_pos`` mask (unwritten slots mask out) — static shapes, no
+  data-dependent slicing under jit;
+- generation is one ``lax.scan`` over steps (greedy argmax feedback), so
+  an N-token generation is a single XLA program, not N dispatches;
+- tensor-parallel serving falls out of GSPMD: the same einsums shard on
+  ``tp`` when params carry :func:`llama_param_specs` shardings.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubegpu_tpu.models.llama import LlamaConfig, _rmsnorm, _rope
+from kubegpu_tpu.ops.flash_attention import NEG_INF, repeat_kv
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int,
+                  max_len: int | None = None) -> dict:
+    """Zeroed stacked cache; ``max_len`` defaults to cfg.max_seq_len."""
+    s = max_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, s, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.jdtype),
+            "v": jnp.zeros(shape, cfg.jdtype)}
+
+
+def _cached_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                   q_pos: jax.Array) -> jax.Array:
+    """q: [B, Hq, T, D]; cache k/v: [B, Hkv, S, D]; q_pos: [T] global
+    positions.  Masks ``k_pos > q_pos`` — causality and the unwritten
+    tail of the cache in one predicate."""
+    k, v = repeat_kv(q, ck, cv)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(k.shape[2])
+    scores = jnp.where((k_pos[None, :] <= q_pos[:, None])[None, None],
+                       scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bhsd->bhtd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
+                        pos_offset: jax.Array, cfg: LlamaConfig
+                        ) -> tuple[jax.Array, dict]:
+    """Run the decoder over ``tokens`` [B, T] starting at global position
+    ``pos_offset`` (scalar), reading + writing the cache.  Returns
+    (logits [B, T, vocab] f32, updated cache).  T=prompt for prefill,
+    T=1 for decode — same code path, same executable shape per T."""
+    b, t = tokens.shape
+    hd = cfg.head_dim
+    x = jnp.take(params["embed"], tokens, axis=0)
+    q_pos = pos_offset + jnp.arange(t)
+    positions = jnp.broadcast_to(q_pos[None, :], (b, t))
+
+    def layer(x, xs):
+        lp, ck, cv = xs
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        # write the new K/V rows at pos_offset (cache is [B, Hkv, S, D])
+        ck = lax.dynamic_update_slice(
+            ck, k.transpose(0, 2, 1, 3).astype(ck.dtype),
+            (0, 0, pos_offset, 0))
+        cv = lax.dynamic_update_slice(
+            cv, v.transpose(0, 2, 1, 3).astype(cv.dtype),
+            (0, 0, pos_offset, 0))
+        o = _cached_attend(q.transpose(0, 2, 1, 3), ck, cv, q_pos)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+        x = x + (up @ lp["w_down"]).astype(x.dtype)
+        return x, (ck, cv)
+
+    x, (ck_new, cv_new) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ck_new, "v": cv_new}
+
+
+def prefill(params: dict, prompt: jax.Array, cfg: LlamaConfig,
+            max_len: int | None = None) -> tuple[jax.Array, dict]:
+    """Process the whole prompt [B, T]; returns (last-position logits
+    [B, vocab], primed cache)."""
+    cache = init_kv_cache(cfg, prompt.shape[0], max_len)
+    logits, cache = _forward_with_cache(
+        params, prompt, cache, jnp.int32(0), cfg)
+    return logits[:, -1], cache
+
+
+def decode_step(params: dict, cache: dict, token: jax.Array,
+                pos: jax.Array, cfg: LlamaConfig
+                ) -> tuple[jax.Array, dict]:
+    """One token in, next-token logits out.  token: [B], pos: scalar
+    global position of ``token``."""
+    logits, cache = _forward_with_cache(
+        params, token[:, None], cache, pos, cfg)
+    return logits[:, 0], cache
+
+
+@functools.lru_cache(maxsize=64)
+def _generate_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int):
+    """One compiled executable per (config, prompt len, steps, cache len)
+    — repeat generations with the same shapes hit XLA's cache instead of
+    re-tracing (the jit cache is keyed on the function object, so it must
+    be created once per static signature, not per call)."""
+
+    @jax.jit
+    def run(params, prompt):
+        logits, cache = prefill(params, prompt, cfg, max_len)
+        first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+        def step(carry, i):
+            token, cache = carry
+            logits, cache = decode_step(params, cache, token, t + i, cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
+            return (nxt, cache), token
+
+        (_, _), toks = lax.scan(
+            step, (first, cache), jnp.arange(n_steps))
+        return toks.swapaxes(0, 1)   # [B, n_steps]
+
+    return run
+
+
+def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
+                    cfg: LlamaConfig,
+                    max_len: int | None = None) -> jax.Array:
+    """Greedy decode ``n_steps`` tokens after ``prompt`` [B, T] — prefill
+    plus one scanned decode loop, all inside a single jit.  Returns the
+    generated tokens [B, n_steps]."""
+    max_len = max_len or cfg.max_seq_len
+    t = prompt.shape[1]
+    if t + n_steps > max_len:
+        raise ValueError(f"prompt {t} + steps {n_steps} > max_len {max_len}")
+    return _generate_fn(cfg, t, n_steps, max_len)(params, prompt)

@@ -286,9 +286,10 @@ class DeviceScheduler:
                     if preq is not None:
                         protected.append(preq)
                 continue
+            precomputed = None
             if barrier is not None:
-                allowed, ureq = self._may_backfill(kind, unit, gangs,
-                                                   protected)
+                allowed, ureq, precomputed = self._may_backfill(
+                    kind, unit, gangs, protected)
                 if not allowed:
                     names = ([unit.name] if kind == "single" else
                              [p.name for p in gangs[unit].pods.values()])
@@ -312,7 +313,8 @@ class DeviceScheduler:
                     self._reject(pod.name, [pod], str(e), result)
                     continue
                 self._schedule_gang(pod.name, [pod], req, result,
-                                    priority=pod.spec.priority)
+                                    priority=pod.spec.priority,
+                                    precomputed=precomputed)
                 continue
             gname = unit
             pg = gangs[gname]
@@ -324,7 +326,8 @@ class DeviceScheduler:
                 self._reject(gname, members, str(e), result)
                 continue
             self._schedule_gang(gname, members, req, result,
-                                priority=pg.priority)
+                                priority=pg.priority,
+                                precomputed=precomputed)
         return result
 
     # ------------------------------------------------------------------
@@ -349,15 +352,18 @@ class DeviceScheduler:
 
     def _may_backfill(self, kind: str, unit, gangs: dict,
                       protected: list[GangRequest]
-                      ) -> tuple[bool, GangRequest | None]:
+                      ) -> tuple[bool, GangRequest | None,
+                                 "GangAssignment | None"]:
         """Conservative backfill past the in-grace barrier: the unit may
         schedule iff a what-if trial shows every EARLIER-QUEUED held
         unit's request that fits today still fits after the unit is
         placed (requests are committed sequentially in queue order on
-        both sides of the comparison).  Returns (allowed, request): the
-        request comes back only when the unit is denied, so the caller
-        can protect it from later backfillers in turn.  0-device units
-        always pass (no TPU contention)."""
+        both sides of the comparison).  Returns (allowed, request,
+        assignment): the request comes back only when the unit is denied
+        (so the caller can protect it from later backfillers); the probe
+        assignment comes back on success so ``_schedule_gang`` doesn't
+        repeat the placement search.  0-device units always pass (no TPU
+        contention)."""
         try:
             if kind == "single":
                 req = self._request_for_single(unit)
@@ -366,17 +372,17 @@ class DeviceScheduler:
                 req = self._request_for_gang(
                     unit, [pg.pods[i] for i in range(pg.spec.size)])
         except ValueError:
-            return True, None   # rejected downstream; no resource risk
+            return True, None, None  # rejected downstream; no resource risk
         if req.total_chips == 0 and req.millitpu_per_pod == 0:
-            return True, None
+            return True, None, None
         # find_assignment is read-only, so probe placement on the real
         # state first and clone only if the what-if comparison is needed
         asg = self.allocator.find_assignment(list(self.slices.values()), req)
         if asg is None:
-            return False, req  # can't place now; held (not failed), and
-            #                    protected so later units can't leapfrog
+            return False, req, None  # can't place now; held (not failed),
+            #                          and protected against leapfrogging
         if not protected:
-            return True, None
+            return True, None, asg
         after = {sid: st.clone() for sid, st in self.slices.items()}
         self.allocator.commit(after, asg)
         before = {sid: st.clone() for sid, st in self.slices.items()}
@@ -389,9 +395,9 @@ class DeviceScheduler:
             a_after = self.allocator.find_assignment(
                 list(after.values()), preq)
             if a_after is None:
-                return False, req
+                return False, req, None
             self.allocator.commit(after, a_after)
-        return True, None
+        return True, None, asg
 
     def _reject(self, gang: str, members: list[Pod], reason: str,
                 result: ScheduleResult) -> None:
@@ -403,7 +409,8 @@ class DeviceScheduler:
 
     def _schedule_gang(self, gang_name: str, members: list[Pod],
                        req: GangRequest, result: ScheduleResult,
-                       priority: int = 0) -> None:
+                       priority: int = 0,
+                       precomputed: GangAssignment | None = None) -> None:
         t0 = time.perf_counter()
         # 0-device pods (CPU fallback, BASELINE config 1): bind to any
         # ready node, TPU-bearing or not.
@@ -420,7 +427,10 @@ class DeviceScheduler:
             self._observe_latency(t0, gang_name, scheduled=True)
             return
 
-        asg = self.allocator.find_assignment(list(self.slices.values()), req)
+        # the backfill probe may have found the placement already (same
+        # slice state — nothing mutates between probe and here)
+        asg = precomputed if precomputed is not None else \
+            self.allocator.find_assignment(list(self.slices.values()), req)
         preemptible = any(p < priority for p in self._gang_priority.values())
         if asg is None and preemptible:
             victims = self._plan_preemption(req, priority)
@@ -501,11 +511,11 @@ class DeviceScheduler:
         'youngest victim'), then a minimization pass re-admits any victim
         the fit doesn't actually need.  Returns None when no eviction set
         works (then nobody is evicted — no pointless thrash)."""
+        idx = {g: i for i, g in enumerate(self._committed)}
         order = sorted(
             (g for g in self._committed
              if self._gang_priority.get(g, 0) < priority),
-            key=lambda g: (self._gang_priority.get(g, 0),
-                           -list(self._committed).index(g)))
+            key=lambda g: (self._gang_priority.get(g, 0), -idx[g]))
         if not order:
             return None
         trial = {sid: st.clone() for sid, st in self.slices.items()}
