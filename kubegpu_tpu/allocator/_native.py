@@ -78,6 +78,15 @@ def get_lib() -> ctypes.CDLL | None:
     lib.ktpu_orient_rings.argtypes = [
         ctypes.POINTER(i32), ctypes.POINTER(i32), ctypes.POINTER(i32),
         i32, i32, ctypes.POINTER(i32)]
+    lib.ktpu_align_units.restype = i32
+    lib.ktpu_align_units.argtypes = [
+        ctypes.POINTER(i32), ctypes.POINTER(i32), i32, i32,
+        ctypes.POINTER(i32)]
+    lib.ktpu_connected_order.restype = i32
+    lib.ktpu_connected_order.argtypes = [
+        i32, i32, i32, i32, i32, i32,
+        ctypes.POINTER(ctypes.c_uint8), i32, i32, i32,
+        i32, i32, i32, ctypes.POINTER(i32)]
     _lib = lib
     return _lib
 
@@ -202,6 +211,62 @@ def orient_rings_native(options: list[list[list[Coord]]],
     for b in range(n_blocks):
         out.extend(options[b][choice[b]])
     return out
+
+
+def align_units_native(options: list[list[list[Coord]]]
+                       ) -> list[Coord] | None:
+    """Native Viterbi ring alignment (gang.py ``_align_units``):
+    ``options[u]`` is unit u's orientation-variant list (all variants the
+    same length).  Returns the assembled coord sequence or None to fall
+    back to Python."""
+    lib = get_lib()
+    if lib is None or len(options) < 2:
+        return None
+    opt_len = len(options[0][0])
+    n_units = len(options)
+    n_opts = (ctypes.c_int32 * n_units)(*[len(o) for o in options])
+    flat: list[int] = []
+    for unit in options:
+        for opt in unit:
+            for (x, y, z) in opt:
+                flat.extend((x, y, z))
+    data = (ctypes.c_int32 * len(flat))(*flat)
+    choice = (ctypes.c_int32 * n_units)()
+    rc = lib.ktpu_align_units(data, n_opts, opt_len, n_units, choice)
+    if rc != 0:
+        return None
+    out: list[Coord] = []
+    for u in range(n_units):
+        out.extend(options[u][choice[u]])
+    return out
+
+
+def connected_order_native(
+    topo: TpuTopology, blocked: set[Coord], total: int,
+    chips_per_pod: int, num_pods: int
+) -> tuple[bool, list[Coord] | None] | None:
+    """Native connected-region fallback search (gang.py
+    ``_connected_candidate``): returns (True, order) with the chunked
+    chip order, (False, None) when provably no start works, or None to
+    fall back to Python (library unavailable)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    mx, my, mz = topo.spec.mesh_shape
+    wx, wy, wz = topo.spec.wrap
+    hx, hy, hz = topo.spec.host_block
+    occ = _occupancy_mask(topo, blocked)
+    out = (ctypes.c_int32 * (total * 3))()
+    rc = lib.ktpu_connected_order(
+        mx, my, mz, int(wx), int(wy), int(wz), occ, hx, hy, hz,
+        total, chips_per_pod, num_pods, out)
+    if rc == 1:
+        return False, None
+    if rc != 0:
+        return None
+    order = [(out[i * 3], out[i * 3 + 1], out[i * 3 + 2])
+             for i in range(total)]
+    return True, order
 
 
 def fragmentation_score_native(

@@ -13,9 +13,11 @@
 //   - coords cross the ABI as flat int32 triples [x0,y0,z0, x1,y1,z1, ...]
 //   - occupancy is a uint8 mask over cell indices (1 = blocked)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <unordered_set>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -327,6 +329,195 @@ double ktpu_fragmentation_score(int32_t mx, int32_t my, int32_t mz,
     }
   }
   return boundary ? (double)blocked / (double)boundary : 1.0;
+}
+
+// Viterbi ring alignment (gang.py _align_units): choose an orientation per
+// ring so POSITION-WISE pairs between consecutive rings (and last→first)
+// maximize unwrapped-ICI adjacency.  All rings share opt_len; unit 0's
+// start is restricted to its first two variants (identity/reversal —
+// global rotations preserve all pairwise gains).  Tie-breaking matches the
+// Python reference exactly: strict >, first maximum wins, earlier start
+// wins, option index order.
+int32_t ktpu_align_units(const int32_t* opts_data, const int32_t* n_opts,
+                         int32_t opt_len, int32_t n_units,
+                         int32_t* out_choice) {
+  if (n_units < 2 || opt_len <= 0) return -1;
+  std::vector<int64_t> unit_off(n_units);
+  int64_t off = 0;
+  int max_opts = 0;
+  for (int u = 0; u < n_units; ++u) {
+    if (n_opts[u] <= 0) return -1;
+    unit_off[u] = off;
+    off += (int64_t)n_opts[u] * opt_len * 3;
+    if (n_opts[u] > max_opts) max_opts = n_opts[u];
+  }
+  auto opt_ptr = [&](int u, int j) {
+    return opts_data + unit_off[u] + (int64_t)j * opt_len * 3;
+  };
+  // positions i of rings a, b with |Δ| manhattan (no wrap) == 1
+  auto gain = [&](const int32_t* a, const int32_t* b) -> int64_t {
+    int64_t g = 0;
+    for (int i = 0; i < opt_len; ++i) {
+      const int32_t* p = a + (int64_t)i * 3;
+      const int32_t* q = b + (int64_t)i * 3;
+      int d = 0;
+      for (int k = 0; k < 3; ++k) {
+        int delta = p[k] - q[k];
+        d += delta < 0 ? -delta : delta;
+      }
+      if (d == 1) ++g;
+    }
+    return g;
+  };
+
+  std::vector<int64_t> score(max_opts), nscore(max_opts);
+  std::vector<int32_t> back((size_t)(n_units > 2 ? n_units - 2 : 0)
+                            * max_opts);
+  std::vector<int32_t> best_path(n_units);
+  int64_t best_total = -1;
+  const int n_starts = n_opts[0] < 2 ? n_opts[0] : 2;
+
+  for (int start = 0; start < n_starts; ++start) {
+    const int32_t* s0 = opt_ptr(0, start);
+    for (int j = 0; j < n_opts[1]; ++j)
+      score[j] = gain(s0, opt_ptr(1, j));
+    for (int i = 2; i < n_units; ++i) {
+      for (int j = 0; j < n_opts[i]; ++j) {
+        int64_t bs = -1;
+        int32_t bj = 0;
+        for (int pj = 0; pj < n_opts[i - 1]; ++pj) {
+          int64_t s = score[pj] + gain(opt_ptr(i - 1, pj), opt_ptr(i, j));
+          if (s > bs) {
+            bs = s;
+            bj = pj;
+          }
+        }
+        nscore[j] = bs;
+        back[(size_t)(i - 2) * max_opts + j] = bj;
+      }
+      std::swap(score, nscore);
+    }
+    for (int j = 0; j < n_opts[n_units - 1]; ++j) {
+      int64_t total = score[j] + gain(opt_ptr(n_units - 1, j), s0);
+      if (total > best_total) {
+        best_total = total;
+        int cur = j;
+        for (int i = n_units - 1; i >= 2; --i) {
+          best_path[i] = cur;
+          cur = back[(size_t)(i - 2) * max_opts + cur];
+        }
+        best_path[1] = cur;
+        best_path[0] = start;
+      }
+    }
+  }
+  for (int u = 0; u < n_units; ++u) out_choice[u] = best_path[u];
+  return 0;
+}
+
+// Connected-region fallback search (gang.py _connected_candidate): from
+// each free coord in lexicographic order, grow a connected set of free
+// chips with a sorted-frontier BFS (a min-heap keyed on coord — identical
+// pop order to the Python frontier.sort(); pop(0)), then chunk it
+// host-locally (pods take chips_per_pod chips host by host, hosts in id
+// order).  Returns 0 + the first start whose chunked order covers `total`
+// chips in exactly `num_pods` chunks, 1 when no start works, -1 on bad
+// args.  Host ids are row-major (z fastest) over the host-block grid,
+// matching TpuTopology.build.
+int32_t ktpu_connected_order(int32_t mx, int32_t my, int32_t mz, int32_t wx,
+                             int32_t wy, int32_t wz,
+                             const uint8_t* blocked, int32_t hx, int32_t hy,
+                             int32_t hz, int32_t total,
+                             int32_t chips_per_pod, int32_t num_pods,
+                             int32_t* out_order) {
+  MeshView m{mx, my, mz, wx != 0, wy != 0, wz != 0};
+  if (total <= 0 || chips_per_pod <= 0 || hx <= 0 || hy <= 0 || hz <= 0)
+    return -1;
+  const int n = m.ncells();
+  const int hosts_y = (my + hy - 1) / hy, hosts_z = (mz + hz - 1) / hz;
+  auto host_of = [&](int x, int y, int z) {
+    return ((x / hx) * hosts_y + y / hy) * hosts_z + z / hz;
+  };
+  // free cells in lexicographic coord order == ascending cell index
+  // (cell = (x*my + y)*mz + z is monotone in (x, y, z))
+  std::vector<int32_t> free_cells;
+  free_cells.reserve(n);
+  for (int i = 0; i < n; ++i)
+    if (!blocked[i]) free_cells.push_back(i);
+  if ((int)free_cells.size() < total) return 1;
+
+  std::vector<uint8_t> seen(n);
+  std::vector<int32_t> heap, region, order;
+  auto decode = [&](int cell, int32_t* xyz) {
+    xyz[2] = cell % mz;
+    xyz[1] = (cell / mz) % my;
+    xyz[0] = cell / (mz * my);
+  };
+  for (int32_t start : free_cells) {
+    std::fill(seen.begin(), seen.end(), 0);
+    heap.clear();
+    region.clear();
+    seen[start] = 1;
+    heap.push_back(start);
+    auto cmp = [](int32_t a, int32_t b) { return a > b; };  // min-heap
+    while (!heap.empty()) {
+      std::pop_heap(heap.begin(), heap.end(), cmp);
+      int32_t cur = heap.back();
+      heap.pop_back();
+      region.push_back(cur);
+      if ((int)region.size() >= total) break;
+      int32_t c[3];
+      decode(cur, c);
+      for (int axis = 0; axis < 3; ++axis) {
+        const int dim = m.dim(axis);
+        if (dim == 1) continue;
+        for (int delta = -1; delta <= 1; delta += 2) {
+          int32_t nb[3] = {c[0], c[1], c[2]};
+          nb[axis] += delta;
+          if (nb[axis] < 0 || nb[axis] >= dim) {
+            if (!(m.wrap(axis) && dim > 2)) continue;
+            nb[axis] = ((nb[axis] % dim) + dim) % dim;
+          }
+          const int cell = m.cell(nb[0], nb[1], nb[2]);
+          if (!seen[cell] && !blocked[cell]) {
+            seen[cell] = 1;
+            heap.push_back(cell);
+            std::push_heap(heap.begin(), heap.end(), cmp);
+          }
+        }
+      }
+    }
+    if ((int)region.size() < total) continue;
+    // group by host id; region cells are in BFS order, so sort each
+    // host's chips (cell order == coord order)
+    std::vector<std::pair<int32_t, int32_t>> host_cell;  // (host, cell)
+    host_cell.reserve(region.size());
+    for (int32_t cell : region) {
+      int32_t c[3];
+      decode(cell, c);
+      host_cell.emplace_back(host_of(c[0], c[1], c[2]), cell);
+    }
+    std::sort(host_cell.begin(), host_cell.end());
+    order.clear();
+    int chunks_formed = 0;
+    for (size_t i = 0; i < host_cell.size() && (int)order.size() < total;) {
+      size_t j = i;
+      while (j < host_cell.size() && host_cell[j].first == host_cell[i].first)
+        ++j;
+      const int in_host = (int)(j - i);
+      const int usable = (in_host / chips_per_pod) * chips_per_pod;
+      int take = total - (int)order.size();
+      if (usable < take) take = usable;
+      for (int k = 0; k < take; ++k)
+        order.push_back(host_cell[i + k].second);
+      chunks_formed += take / chips_per_pod;
+      i = j;
+    }
+    if ((int)order.size() != total || chunks_formed != num_pods) continue;
+    for (int i = 0; i < total; ++i) decode(order[i], out_order + i * 3);
+    return 0;
+  }
+  return 1;
 }
 
 }  // extern "C"

@@ -24,6 +24,13 @@ double ktpu_eval_order(int32_t, int32_t, int32_t, int32_t, int32_t, int32_t,
 double ktpu_fragmentation_score(int32_t, int32_t, int32_t, int32_t, int32_t,
                                 int32_t, const uint8_t*, const int32_t*,
                                 int32_t);
+int32_t ktpu_orient_rings(const int32_t*, const int32_t*, const int32_t*,
+                          int32_t, int32_t, int32_t*);
+int32_t ktpu_align_units(const int32_t*, const int32_t*, int32_t, int32_t,
+                         int32_t*);
+int32_t ktpu_connected_order(int32_t, int32_t, int32_t, int32_t, int32_t,
+                             int32_t, const uint8_t*, int32_t, int32_t,
+                             int32_t, int32_t, int32_t, int32_t, int32_t*);
 }
 
 struct MeshCase {
@@ -103,6 +110,73 @@ int main() {
   if (ktpu_eval_order(4, 4, 1, 0, 0, 0, order, 2, ax, w, 1) != -1.0) {
     std::fprintf(stderr, "mismatch not detected\n");
     return 1;
+  }
+
+  // Viterbi entry points: random ring option sets, varied unit counts
+  for (int n_units = 2; n_units <= 6; ++n_units) {
+    const int opt_len = 4, n_var = 8;
+    std::vector<int32_t> n_opts(n_units, n_var);
+    std::vector<int32_t> opt_lens(n_units, opt_len);
+    std::vector<int32_t> data((size_t)n_units * n_var * opt_len * 3);
+    for (auto& v : data) v = (int32_t)(xorshift() % 8);
+    std::vector<int32_t> choice(n_units, -1);
+    if (ktpu_align_units(data.data(), n_opts.data(), opt_len, n_units,
+                         choice.data()) != 0) {
+      std::fprintf(stderr, "align_units failed\n");
+      return 1;
+    }
+    for (int u = 0; u < n_units; ++u)
+      if (choice[u] < 0 || choice[u] >= n_var) {
+        std::fprintf(stderr, "align_units choice out of range\n");
+        return 1;
+      }
+    for (int close = 0; close <= 1; ++close) {
+      std::vector<int32_t> choice2(n_units, -1);
+      if (ktpu_orient_rings(data.data(), n_opts.data(), opt_lens.data(),
+                            n_units, close, choice2.data()) != 0) {
+        std::fprintf(stderr, "orient_rings failed\n");
+        return 1;
+      }
+      for (int u = 0; u < n_units; ++u)
+        if (choice2[u] < 0 || choice2[u] >= n_var) {
+          std::fprintf(stderr, "orient_rings choice out of range\n");
+          return 1;
+        }
+    }
+  }
+
+  // connected-order fallback: output chips must be free and distinct
+  for (const auto& m : meshes) {
+    const int ncells = m.mx * m.my * m.mz;
+    std::vector<uint8_t> occ(ncells);
+    for (int i = 0; i < ncells; ++i) occ[i] = xorshift() % 3 == 0;
+    for (int pods = 1; pods <= 4; ++pods) {
+      for (int cpp = 1; cpp <= 2; ++cpp) {
+        const int total = pods * cpp;
+        if (total > ncells) continue;
+        std::vector<int32_t> out((size_t)total * 3, -1);
+        int rc = ktpu_connected_order(m.mx, m.my, m.mz, m.wx, m.wy, m.wz,
+                                      occ.data(), 2, 2, 1, total, cpp,
+                                      pods, out.data());
+        if (rc < 0) {
+          std::fprintf(stderr, "connected_order bad args rc=%d\n", rc);
+          return 1;
+        }
+        if (rc == 0) {
+          std::vector<uint8_t> seen(ncells);
+          for (int i = 0; i < total; ++i) {
+            const int32_t* c = out.data() + i * 3;
+            const int cell = (c[0] * m.my + c[1]) * m.mz + c[2];
+            if (cell < 0 || cell >= ncells || occ[cell] || seen[cell]) {
+              std::fprintf(stderr, "connected_order bad chip\n");
+              return 1;
+            }
+            seen[cell] = 1;
+          }
+        }
+        ++checked;
+      }
+    }
   }
   std::printf("sanitize OK: %d placements checked\n", checked);
   return 0;

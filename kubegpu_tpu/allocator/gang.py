@@ -451,7 +451,12 @@ def _align_units(units: list[list[Coord]], step: int) -> list[Coord] | None:
     """
     if len(units) < 2 or len({len(u) for u in units}) != 1:
         return None
+    from kubegpu_tpu.allocator import _native
+
     options = [_cycle_variants(u, step) for u in units]
+    native = _native.align_units_native(options)
+    if native is not None:
+        return native
 
     def gain(a: list[Coord], b: list[Coord]) -> int:
         return sum(1 for p, q in zip(a, b) if _dist(p, q) == 1)
@@ -584,8 +589,26 @@ class GangAllocator:
                              blocked: set[Coord],
                              axes: dict[str, int]) -> _Candidate | None:
         """BFS-grow a connected set of free chips, chunked host-locally."""
+        from kubegpu_tpu.allocator import _native
+
         total = req.total_chips
         c = req.chips_per_pod
+        res = _native.connected_order_native(st.topo, blocked, total, c,
+                                             req.num_pods)
+        if res is not None:
+            found, order = res
+            if not found:
+                return None
+            loc = evaluate_order(st.topo, order, axes, req.axis_weights,
+                                 st.bad_links)
+            pl = Placement(origin=min(order), shape=(0, 0, 0),
+                           coords=tuple(order))
+            frag = fragmentation_score(st.topo, blocked, pl)
+            score = 10.0 * (self.locality_weight * loc
+                            + self.frag_weight * frag
+                            + self.fill_weight * st.fill_fraction())
+            return _Candidate(slice_state=st, placement=pl, order=order,
+                              locality=loc, score=score)
         free = sorted({ch.coord for ch in st.topo.chips} - blocked)
         for start in free:
             seen = {start}

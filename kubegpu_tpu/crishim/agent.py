@@ -102,10 +102,9 @@ class NodeAgent:
             return []
         self.reconcile()
         started: list[ContainerHandle] = []
-        for pod in self.api.list("Pod"):
-            if (pod.spec.node_name == self.node_name
-                    and pod.status.phase == PodPhase.SCHEDULED
-                    and pod.name not in self.handles):
+        for pod in self.api.list("Pod", node_name=self.node_name,
+                                 phase=PodPhase.SCHEDULED):
+            if pod.name not in self.handles:
                 handle = self.shim.create_container(pod)
                 self.handles[pod.name] = handle
                 self._uids[pod.name] = pod.metadata.uid

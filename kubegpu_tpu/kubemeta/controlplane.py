@@ -14,7 +14,6 @@ stress-tested in tests/test_controlplane.py).
 
 from __future__ import annotations
 
-import copy
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Iterator
@@ -72,9 +71,9 @@ class FakeApiServer:
             if key in store.objects:
                 raise Conflict(f"{kind} {key} already exists")
             self._bump(obj)
-            store.objects[key] = copy.deepcopy(obj)
-            self._notify(WatchEvent(kind, "ADDED", copy.deepcopy(obj)))
-            return copy.deepcopy(obj)
+            store.objects[key] = obj.clone()
+            self._notify(WatchEvent(kind, "ADDED", obj.clone()))
+            return obj.clone()
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         with self._lock:
@@ -82,9 +81,21 @@ class FakeApiServer:
             key = self._key(namespace, name)
             if key not in store.objects:
                 raise NotFound(f"{kind} {key}")
-            return copy.deepcopy(store.objects[key])
+            return store.objects[key].clone()
 
-    def list(self, kind: str, label_selector: dict[str, str] | None = None):
+    def list(self, kind: str, label_selector: dict[str, str] | None = None,
+             *, node_name: str | None = None, phase=None):
+        """``node_name``/``phase`` are field selectors (k8s
+        ``spec.nodeName=...``/``status.phase=...``): filtering happens
+        BEFORE the per-object copy, so a node agent asking for its own
+        scheduled pods doesn't pay for cloning the whole cluster.
+        ``phase`` accepts one PodPhase or a tuple of them.  Both are
+        Pod-only selectors."""
+        if (node_name is not None or phase is not None) and kind != "Pod":
+            raise ValueError(
+                f"node_name/phase are Pod field selectors (kind={kind})")
+        if phase is not None and not isinstance(phase, tuple):
+            phase = (phase,)
         with self._lock:
             out = []
             for obj in self._stores[kind].objects.values():
@@ -93,7 +104,12 @@ class FakeApiServer:
                     for k, v in label_selector.items()
                 ):
                     continue
-                out.append(copy.deepcopy(obj))
+                if node_name is not None \
+                        and obj.spec.node_name != node_name:
+                    continue
+                if phase is not None and obj.status.phase not in phase:
+                    continue
+                out.append(obj.clone())
             return out
 
     def update(self, kind: str, obj) -> object:
@@ -109,9 +125,9 @@ class FakeApiServer:
                     f"{kind} {key}: rv {obj.metadata.resource_version} != "
                     f"{current.metadata.resource_version}")
             self._bump(obj)
-            store.objects[key] = copy.deepcopy(obj)
-            self._notify(WatchEvent(kind, "MODIFIED", copy.deepcopy(obj)))
-            return copy.deepcopy(obj)
+            store.objects[key] = obj.clone()
+            self._notify(WatchEvent(kind, "MODIFIED", obj.clone()))
+            return obj.clone()
 
     def patch_annotations(self, kind: str, name: str,
                           annotations: dict[str, str],
@@ -128,8 +144,8 @@ class FakeApiServer:
             obj = store.objects[key]
             obj.metadata.annotations.update(annotations)
             self._bump(obj)
-            self._notify(WatchEvent(kind, "MODIFIED", copy.deepcopy(obj)))
-            return copy.deepcopy(obj)
+            self._notify(WatchEvent(kind, "MODIFIED", obj.clone()))
+            return obj.clone()
 
     def bind_pod(self, name: str, node_name: str,
                  namespace: str = "default") -> None:
@@ -143,7 +159,7 @@ class FakeApiServer:
             pod.spec.node_name = node_name
             pod.status.phase = PodPhase.SCHEDULED
             self._bump(pod)
-            self._notify(WatchEvent("Pod", "MODIFIED", copy.deepcopy(pod)))
+            self._notify(WatchEvent("Pod", "MODIFIED", pod.clone()))
 
     def set_pod_phase(self, name: str, phase, message: str = "",
                       exit_code: int | None = None,
@@ -166,7 +182,7 @@ class FakeApiServer:
             if exit_code is not None:
                 pod.status.exit_code = exit_code
             self._bump(pod)
-            self._notify(WatchEvent("Pod", "MODIFIED", copy.deepcopy(pod)))
+            self._notify(WatchEvent("Pod", "MODIFIED", pod.clone()))
 
     def set_node_ready(self, name: str, ready: bool,
                        namespace: str = "default") -> None:
@@ -182,7 +198,7 @@ class FakeApiServer:
                 return
             node.status.ready = ready
             self._bump(node)
-            self._notify(WatchEvent("Node", "MODIFIED", copy.deepcopy(node)))
+            self._notify(WatchEvent("Node", "MODIFIED", node.clone()))
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         with self._lock:
@@ -191,7 +207,7 @@ class FakeApiServer:
             if key not in store.objects:
                 raise NotFound(f"{kind} {key}")
             obj = store.objects.pop(key)
-            self._notify(WatchEvent(kind, "DELETED", copy.deepcopy(obj)))
+            self._notify(WatchEvent(kind, "DELETED", obj.clone()))
 
     # -- watch -----------------------------------------------------------
 

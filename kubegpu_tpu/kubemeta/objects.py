@@ -38,6 +38,13 @@ class ObjectMeta:
     uid: str = field(default_factory=lambda: f"uid-{next(_uid_counter)}")
     resource_version: int = 0
 
+    def clone(self) -> "ObjectMeta":
+        return ObjectMeta(name=self.name, namespace=self.namespace,
+                          labels=dict(self.labels),
+                          annotations=dict(self.annotations),
+                          uid=self.uid,
+                          resource_version=self.resource_version)
+
 
 @dataclass
 class ResourceRequests:
@@ -74,6 +81,13 @@ class ContainerSpec:
     env: dict[str, str] = field(default_factory=dict)
     resources: ResourceRequests = field(default_factory=ResourceRequests)
 
+    def clone(self) -> "ContainerSpec":
+        return ContainerSpec(
+            name=self.name, command=list(self.command), image=self.image,
+            env=dict(self.env),
+            resources=ResourceRequests(tpu_chips=self.resources.tpu_chips,
+                                       millitpu=self.resources.millitpu))
+
 
 @dataclass
 class GangSpec:
@@ -108,6 +122,12 @@ class PodSpec:
     def total_millitpu(self) -> int:
         return sum(c.resources.millitpu for c in self.containers)
 
+    def clone(self) -> "PodSpec":
+        return PodSpec(containers=[c.clone() for c in self.containers],
+                       node_name=self.node_name,
+                       scheduler_name=self.scheduler_name,
+                       priority=self.priority)
+
 
 @dataclass
 class PodStatus:
@@ -126,6 +146,15 @@ class Pod:
     def name(self) -> str:
         return self.metadata.name
 
+    def clone(self) -> "Pod":
+        """Structural deep copy — hand-rolled because the fake apiserver
+        copies on every read/notify and ``copy.deepcopy``'s generic memo
+        machinery dominated the control-plane profile (87% of step())."""
+        return Pod(metadata=self.metadata.clone(), spec=self.spec.clone(),
+                   status=PodStatus(phase=self.status.phase,
+                                    message=self.status.message,
+                                    exit_code=self.status.exit_code))
+
 
 @dataclass
 class NodeStatus:
@@ -140,3 +169,7 @@ class Node:
     @property
     def name(self) -> str:
         return self.metadata.name
+
+    def clone(self) -> "Node":
+        return Node(metadata=self.metadata.clone(),
+                    status=NodeStatus(ready=self.status.ready))

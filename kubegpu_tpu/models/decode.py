@@ -135,10 +135,13 @@ def _generate_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int):
             token, cache = carry
             logits, cache = decode_step(params, cache, token, t + i, cfg)
             nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
-            return (nxt, cache), token
+            return (nxt, cache), nxt
 
-        (_, _), toks = lax.scan(
-            step, (first, cache), jnp.arange(n_steps))
+        # n_steps - 1 decode forwards: the prefill already produced the
+        # first token, and the last token needs no successor logits
+        (_, _), rest = lax.scan(
+            step, (first, cache), jnp.arange(n_steps - 1))
+        toks = jnp.concatenate([first[None], rest], axis=0)
         return toks.swapaxes(0, 1)   # [B, n_steps]
 
     return run

@@ -232,9 +232,8 @@ class DeviceScheduler:
         (deadlock-free work conservation)."""
         result = ScheduleResult()
         now = time.monotonic()
-        pending = [p for p in self.api.list("Pod")
-                   if p.status.phase == PodPhase.PENDING
-                   and p.spec.node_name is None]
+        pending = [p for p in self.api.list("Pod", phase=PodPhase.PENDING)
+                   if p.spec.node_name is None]
         pending.sort(key=lambda p: p.metadata.resource_version)  # FIFO
         gangs: dict[str, _PendingGang] = {}
         units: list[tuple[str, object]] = []  # FIFO by first member

@@ -231,3 +231,83 @@ def test_asan_build_and_run():
                           "ASAN_OPTIONS": "detect_leaks=0"})
     assert res.returncode == 0, res.stdout + res.stderr
     assert "sanitize OK" in res.stdout
+
+
+def test_align_units_parity():
+    """ktpu_align_units picks the identical orientation sequence as the
+    Python Viterbi (tie-breaking included) on randomized ring sets."""
+    import random
+
+    from kubegpu_tpu.allocator import gang as gang_mod
+
+    rng = random.Random(7)
+    for trial in range(120):
+        n_units = rng.randint(2, 6)
+        ring_len = rng.choice([2, 4, 8])
+        step = rng.choice([1, 2])
+        units = []
+        for _ in range(n_units):
+            base = rng.randint(0, 5)
+            units.append([(base + i % 3, (base + i) % 4, rng.randint(0, 2))
+                          for i in range(ring_len)])
+        options = [gang_mod._cycle_variants(u, step) for u in units]
+        nat = _native.align_units_native(options)
+        if nat is None:
+            pytest.skip("native core unavailable")
+        # python reference (bypass the native dispatch inside _align_units)
+        import os
+        os.environ["KUBETPU_NO_NATIVE"] = "1"
+        try:
+            py = gang_mod._align_units(units, step)
+        finally:
+            del os.environ["KUBETPU_NO_NATIVE"]
+        assert nat == py, (trial, units)
+
+
+def test_connected_order_parity(monkeypatch):
+    """Native connected-region fallback returns the same chunked order as
+    the Python BFS, across random occupancy and gang shapes."""
+    import random
+
+    from kubegpu_tpu.allocator.gang import (
+        GangAllocator, GangRequest, SliceState,
+    )
+    from kubegpu_tpu.tpuplugin.mock import MockBackend
+
+    rng = random.Random(11)
+    for trial in range(40):
+        slice_type = rng.choice(["v4-8", "v5e-16", "v5e-64"])
+        spec = MockBackend(slice_type, slice_id="s0").spec
+        advs = [MockBackend(slice_type, host_id=h, slice_id="s0").discover()
+                for h in range(spec.num_hosts)]
+
+        def build():
+            st = SliceState.from_advertisements(advs)
+            # fragment the slice randomly (same picks per build)
+            frag_rng = random.Random(trial)
+            for ch in st.topo.chips:
+                if frag_rng.random() < 0.35:
+                    st.used_millichips[ch.coord] = 1000
+            return st
+
+        pods = rng.choice([1, 2, 3])
+        cpp = rng.choice([1, 2, 3])
+        req = GangRequest("g", num_pods=pods, chips_per_pod=cpp)
+        alloc = GangAllocator()
+        st_n = build()
+        blocked_n = st_n.blocked_for_whole()
+        axes = {"dp": pods * cpp}
+        nat = alloc._connected_candidate(st_n, req, blocked_n, axes)
+        monkeypatch.setenv("KUBETPU_NO_NATIVE", "1")
+        try:
+            st_p = build()
+            py = alloc._connected_candidate(st_p, req,
+                                            st_p.blocked_for_whole(), axes)
+        finally:
+            monkeypatch.delenv("KUBETPU_NO_NATIVE")
+        if py is None:
+            assert nat is None, trial
+        else:
+            assert nat is not None, trial
+            assert nat.order == py.order, trial
+            assert nat.score == pytest.approx(py.score), trial
