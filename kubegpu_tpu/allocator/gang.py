@@ -43,6 +43,10 @@ class GangRequest:
     millitpu_per_pod: int = 0
     mesh_axes: dict[str, int] | None = None       # logical axes, ordered
     axis_weights: dict[str, float] | None = None  # relative collective bytes
+    # permit splitting the gang across slices when no single slice fits:
+    # the FIRST mesh axis (outermost, dp by convention) partitions across
+    # slices, its crossing pairs riding DCN (counted non-local)
+    allow_multislice: bool = False
 
     @property
     def total_chips(self) -> int:
@@ -75,23 +79,37 @@ class PodAssignment:
     node_name: str
     host_id: int
     chips: list[AllocatedChip] = field(default_factory=list)
+    slice_id: str = ""   # "" → the gang's primary slice (single-slice gang)
 
 
 @dataclass
 class GangAssignment:
-    slice_id: str
+    slice_id: str        # primary slice (pods may override — multislice)
     pods: list[PodAssignment]
     locality: float
     score: float
     placement: Placement | None = None
     logical_order: list[Coord] = field(default_factory=list)
 
+    def pod_slice(self, p: PodAssignment) -> str:
+        return p.slice_id or self.slice_id
+
+    @property
+    def slice_ids(self) -> list[str]:
+        """All slices touched, primary first, stable order."""
+        out: list[str] = []
+        for p in self.pods:
+            sid = self.pod_slice(p)
+            if sid not in out:
+                out.append(sid)
+        return out or [self.slice_id]
+
     def to_allocations(self, coordinator_address: str,
                        worker_hostnames: list[str]) -> list[Allocation]:
         return [
             Allocation(
                 node_name=p.node_name,
-                slice_id=self.slice_id,
+                slice_id=self.pod_slice(p),
                 chips=list(p.chips),
                 worker_id=p.pod_index,
                 num_workers=len(self.pods),
@@ -492,6 +510,31 @@ def _align_units(units: list[list[Coord]], step: int) -> list[Coord] | None:
     return best_seq
 
 
+def _multislice_locality(parts: list[tuple[SliceState, list[Coord]]],
+                         axes: dict[str, int],
+                         axis_weights: dict[str, float] | None) -> float:
+    """Weighted ICI locality of a multislice logical order: ring pairs
+    inside one part score against that part's torus (bad links included);
+    pairs spanning parts ride DCN and count non-local.  Coord spaces
+    collide across slices, so coords are disambiguated with a part tag
+    and the shared ring enumeration is reused."""
+    from kubegpu_tpu.topology.locality import traffic_pairs_for_mesh_axes
+
+    tagged = [(pi,) + c for pi, (_, o) in enumerate(parts) for c in o]
+    tm = traffic_pairs_for_mesh_axes(tagged, axes, axis_weights)
+    total_w = local_w = 0.0
+    for (a, b), w in tm.pairs.items():
+        total_w += w
+        if a[0] != b[0]:
+            continue   # DCN crossing
+        st, _ = parts[a[0]]
+        ca, cb = a[1:], b[1:]
+        if (st.topo.are_ici_adjacent(ca, cb)
+                and (min(ca, cb), max(ca, cb)) not in st.bad_links):
+            local_w += w
+    return local_w / total_w if total_w else 1.0
+
+
 def _chunks_host_local(topo: TpuTopology, order: list[Coord], c: int) -> bool:
     for i in range(0, len(order), c):
         hosts = {topo.chip_at(x).host_id for x in order[i:i + c]}
@@ -536,21 +579,31 @@ class GangAllocator:
             cand = self._best_candidate_in_slice(st, req)
             if cand and (best is None or cand.score > best.score):
                 best = cand
+        if best is None and req.allow_multislice and req.num_pods > 1 \
+                and req.chips_per_pod and len(slices) > 1:
+            best = self._multislice_candidate(slices, req)
         return best
 
     def commit(self, slices: dict[str, SliceState],
                assignment: GangAssignment) -> None:
-        """TakePodResources (SURVEY.md §4.2): mutate occupancy atomically."""
-        st = slices[assignment.slice_id]
+        """TakePodResources (SURVEY.md §4.2): mutate occupancy atomically.
+        Skips slices that vanished, symmetric with rollback — a multislice
+        gang re-committed in a what-if trial (recovery's rollback→find→
+        commit) may have lost one slice while another lives on."""
         for p in assignment.pods:
-            st.take(p.chips)
+            st = slices.get(assignment.pod_slice(p))
+            if st is not None:
+                st.take(p.chips)
 
     def rollback(self, slices: dict[str, SliceState],
                  assignment: GangAssignment) -> None:
-        """ReturnPodResources (SURVEY.md §4.4)."""
-        st = slices[assignment.slice_id]
+        """ReturnPodResources (SURVEY.md §4.4).  A slice that vanished
+        (all hosts down) has nothing to release — skip it, free the rest
+        (multislice gangs can lose one slice and keep another)."""
         for p in assignment.pods:
-            st.release(p.chips)
+            st = slices.get(assignment.pod_slice(p))
+            if st is not None:
+                st.release(p.chips)
 
     # -- whole-chip path -------------------------------------------------
 
@@ -697,6 +750,70 @@ class GangAllocator:
             score=cand.score, placement=cand.placement,
             logical_order=cand.order)
 
+    # -- multislice path (DCN-spanning gangs) -----------------------------
+
+    def _multislice_candidate(self, slices: list[SliceState],
+                              req: GangRequest) -> GangAssignment | None:
+        """Split the gang across slices when no single slice fits
+        (SURVEY.md §6 comm-backend row: collectives ride ICI intra-slice,
+        DCN across slices — the Cloud-TPU-multislice shape).
+
+        The FIRST (outermost) mesh axis partitions: n_parts contiguous
+        worker groups land on n_parts distinct slices, so only that axis's
+        rings cross slices.  Fewest parts wins (fewest DCN crossings);
+        reported locality counts every cross-slice traffic pair as
+        non-local — the honest number the ≥90% north-star is judged on.
+        """
+        axes = req.mesh_axes or {"dp": req.total_chips}
+        outer_name = next(iter(axes))
+        outer = axes[outer_name]
+        by_id = {st.slice_id: st for st in slices}
+        max_parts = min(outer, len(slices), req.num_pods)
+        for n_parts in range(2, max_parts + 1):
+            if outer % n_parts or req.num_pods % n_parts:
+                continue
+            sub_axes = dict(axes)
+            sub_axes[outer_name] = outer // n_parts
+            sub_req = GangRequest(
+                gang_name=req.gang_name,
+                num_pods=req.num_pods // n_parts,
+                chips_per_pod=req.chips_per_pod,
+                mesh_axes=sub_axes,
+                axis_weights=req.axis_weights)
+            cands = []
+            for st in slices:
+                c = self._best_candidate_in_slice(st, sub_req)
+                if c is not None:
+                    cands.append(c)
+            if len(cands) < n_parts:
+                continue
+            cands.sort(key=lambda a: (-a.score, a.slice_id))
+            parts = cands[:n_parts]
+            m = req.num_pods // n_parts
+            pods: list[PodAssignment] = []
+            for k, pa in enumerate(parts):
+                for p in pa.pods:
+                    pods.append(PodAssignment(
+                        pod_index=k * m + p.pod_index,
+                        node_name=p.node_name,
+                        host_id=p.host_id,
+                        chips=p.chips,
+                        slice_id=pa.slice_id))
+            loc = _multislice_locality(
+                [(by_id[pa.slice_id], pa.logical_order) for pa in parts],
+                axes, req.axis_weights)
+            # parts' scores blend their (closed-subring) locality; swap in
+            # the honest global figure, keep their frag/fill terms
+            score = (10.0 * self.locality_weight * loc
+                     + sum(pa.score - 10.0 * self.locality_weight
+                           * pa.locality for pa in parts) / n_parts)
+            return GangAssignment(
+                slice_id=parts[0].slice_id, pods=pods, locality=loc,
+                score=score, placement=None,
+                logical_order=[c for pa in parts
+                               for c in pa.logical_order])
+        return None
+
     # -- fractional path -------------------------------------------------
 
     def _find_fractional(self, slices: list[SliceState],
@@ -743,9 +860,14 @@ class GangAllocator:
     def coordinator_for(assignment: GangAssignment,
                         slices: dict[str, SliceState],
                         port: int = COORDINATOR_PORT) -> tuple[str, list[str]]:
-        """(coordinator address, worker hostnames in worker order)."""
-        st = slices[assignment.slice_id]
-        hosts = [p.host_id for p in assignment.pods]
-        names = [st.node_of_host.get(h, f"host-{h}") for h in hosts]
-        ip0 = st.ip_of_host.get(hosts[0], "127.0.0.1")
+        """(coordinator address, worker hostnames in worker order).  Each
+        pod resolves against its own slice (multislice gangs span
+        several); the coordinator is worker 0's host."""
+        names = []
+        for p in assignment.pods:
+            st = slices[assignment.pod_slice(p)]
+            names.append(st.node_of_host.get(p.host_id,
+                                             f"host-{p.host_id}"))
+        st0 = slices[assignment.pod_slice(assignment.pods[0])]
+        ip0 = st0.ip_of_host.get(assignment.pods[0].host_id, "127.0.0.1")
         return f"{ip0}:{port}", names

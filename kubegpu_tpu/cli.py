@@ -62,10 +62,11 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
         command = [str(c) for c in entry.get("command", [])]
         env = {str(k): str(v) for k, v in (entry.get("env") or {}).items()}
         priority = int(entry.get("priority", 0))
+        multislice = bool(entry.get("multislice", False))
         if gang is None:
             pods.append(tpu_pod(name, chips=chips, millitpu=millitpu,
                                 mesh_axes=axes, command=command, env=env,
-                                priority=priority))
+                                priority=priority, multislice=multislice))
             continue
         if isinstance(gang, int):
             gang = {"size": gang}
@@ -76,7 +77,7 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
                 f"{name}-{i}", chips=chips, millitpu=millitpu,
                 gang=GangSpec(name=gname, size=size, index=i),
                 mesh_axes=axes, command=command, env=env,
-                priority=priority))
+                priority=priority, multislice=multislice))
     return pods, slices
 
 

@@ -23,7 +23,11 @@ from kubegpu_tpu.kubemeta import (
     ResourceRequests,
     WatchEvent,
 )
-from kubegpu_tpu.kubemeta.codec import set_pod_gang, set_pod_mesh_axes
+from kubegpu_tpu.kubemeta.codec import (
+    set_pod_gang,
+    set_pod_mesh_axes,
+    set_pod_multislice,
+)
 from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
 from kubegpu_tpu.scheduler import DeviceScheduler
 from kubegpu_tpu.scheduler.health import FaultRecoveryController
@@ -43,7 +47,8 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
             mesh_axes: dict[str, int] | None = None,
             command: list[str] | None = None,
             env: dict[str, str] | None = None,
-            priority: int = 0) -> Pod:
+            priority: int = 0,
+            multislice: bool = False) -> Pod:
     """Pod-spec builder — the user surface (reference: example/ YAML)."""
     pod = Pod(
         metadata=ObjectMeta(name=name),
@@ -58,6 +63,8 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
         set_pod_gang(pod, gang)
     if mesh_axes is not None:
         set_pod_mesh_axes(pod, mesh_axes)
+    if multislice:
+        set_pod_multislice(pod)
     return pod
 
 

@@ -23,6 +23,7 @@ DEVICE_INFO_KEY = "node.alpha.kubetpu/device-information"
 ALLOCATE_FROM_KEY = "pod.alpha.kubetpu/allocate-from"
 GANG_KEY = "pod.alpha.kubetpu/gang"
 MESH_AXES_KEY = "pod.alpha.kubetpu/mesh-axes"
+MULTISLICE_KEY = "pod.alpha.kubetpu/multislice"
 
 
 # ---------------------------------------------------------------------------
@@ -194,3 +195,16 @@ def pod_mesh_axes(pod: Pod) -> dict[str, int] | None:
     if not payload:
         return None
     return dict((k, int(v)) for k, v in json.loads(payload))
+
+
+def set_pod_multislice(pod: Pod, allowed: bool = True) -> None:
+    """Opt the pod's gang into DCN-spanning placement: when no single
+    slice fits, the outermost mesh axis may partition across slices."""
+    if allowed:
+        pod.metadata.annotations[MULTISLICE_KEY] = "true"
+    else:
+        pod.metadata.annotations.pop(MULTISLICE_KEY, None)
+
+
+def pod_multislice(pod: Pod) -> bool:
+    return pod.metadata.annotations.get(MULTISLICE_KEY) == "true"

@@ -155,6 +155,8 @@ def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
     generated tokens [B, n_steps]."""
     max_len = max_len or cfg.max_seq_len
     t = prompt.shape[1]
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
     if t + n_steps > max_len:
         raise ValueError(f"prompt {t} + steps {n_steps} > max_len {max_len}")
     return _generate_fn(cfg, t, n_steps, max_len)(params, prompt)

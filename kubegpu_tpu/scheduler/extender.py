@@ -42,6 +42,7 @@ from kubegpu_tpu.kubemeta import (
     pod_allocation,
     pod_gang_spec,
     pod_mesh_axes,
+    pod_multislice,
 )
 from kubegpu_tpu.kubemeta.codec import (
     ALLOCATE_FROM_KEY,
@@ -137,18 +138,19 @@ class DeviceScheduler:
         # completions release chips even across scheduler restarts/re-syncs.
         # Gangs whose slice vanished (all hosts down) are kept too — the
         # recovery controller must still see them to evict/requeue, else
-        # they'd zombie as RUNNING pods bound to dead nodes.
+        # they'd zombie as RUNNING pods bound to dead nodes.  Slice ids are
+        # per-pod (a multislice gang spans several).
         for gang, allocs in gang_pods.items():
-            st = self.slices.get(allocs[0].slice_id)
-            pods = [
-                PodAssignment(
+            pods = []
+            for a in sorted(allocs, key=lambda a: a.worker_id):
+                st = self.slices.get(a.slice_id)
+                pods.append(PodAssignment(
                     pod_index=a.worker_id,
                     node_name=a.node_name,
                     host_id=st.topo.chip_at(a.chips[0].coord).host_id
                     if st is not None and a.chips else 0,
-                    chips=list(a.chips))
-                for a in sorted(allocs, key=lambda a: a.worker_id)
-            ]
+                    chips=list(a.chips),
+                    slice_id=a.slice_id))
             self._committed[gang] = GangAssignment(
                 slice_id=allocs[0].slice_id, pods=pods,
                 locality=0.0, score=0.0)
@@ -493,10 +495,11 @@ class DeviceScheduler:
             return
         self._gang_priority.pop(gang, None)
         asg = self._committed.pop(gang, None)
-        if asg is not None and asg.slice_id in self.slices:
+        if asg is not None:
+            # rollback skips slices that vanished (multislice: free the rest)
             self.allocator.rollback(self.slices, asg)
             self.trace.record("release", gang=gang,
-                              detail={"slice": asg.slice_id})
+                              detail={"slices": asg.slice_ids})
 
     # ------------------------------------------------------------------
     # Preemption + eviction (shared with the fault-recovery controller)
@@ -640,6 +643,7 @@ class DeviceScheduler:
             millitpu_per_pod=milli.pop(),
             mesh_axes=self._sane_axes(pod_mesh_axes(members[0]),
                                       len(members) * chips),
+            allow_multislice=pod_multislice(members[0]),
         )
 
     def _slice_of_node(self, node_name: str) -> SliceState | None:

@@ -104,24 +104,35 @@ class FaultRecoveryController:
 
     def _broken_reason(self, asg: GangAssignment) -> tuple[str, str] | None:
         """(human reason, kind) — kind 'hard' (chips gone) or 'link'
-        (degraded: chips fine, an interior ICI link died)."""
-        st = self.scheduler.slices.get(asg.slice_id)
-        if st is None:
-            return "slice disappeared (all hosts down)", "hard"
-        coords = [ch.coord for p in asg.pods for ch in p.chips]
-        coord_set = set(coords)
-        for c in coords:
-            if c not in st.available:
-                return f"chip {c} no longer advertised (host down)", "hard"
-            if c in st.unhealthy:
-                return f"chip {c} marked unhealthy", "hard"
-        # A dead ICI link strictly inside the allocation footprint breaks
-        # the gang's collectives (rings detour → catastrophic slowdown on
-        # a torus) — re-place if anywhere better exists.
-        for a, b in st.bad_links:
-            if a in coord_set and b in coord_set:
-                return f"ICI link {a}–{b} failed inside allocation", "link"
-        return None
+        (degraded: chips fine, an interior ICI link died).  Every slice
+        the gang touches is inspected, and a 'hard' fault anywhere wins
+        over a 'link' fault elsewhere — a multislice gang with one slice
+        merely degraded and another DEAD must evict, not park."""
+        link_found: tuple[str, str] | None = None
+        for sid in asg.slice_ids:
+            st = self.scheduler.slices.get(sid)
+            if st is None:
+                return f"slice {sid} disappeared (all hosts down)", "hard"
+            coords = [ch.coord for p in asg.pods
+                      if asg.pod_slice(p) == sid for ch in p.chips]
+            coord_set = set(coords)
+            for c in coords:
+                if c not in st.available:
+                    return (f"chip {c} no longer advertised (host down)",
+                            "hard")
+                if c in st.unhealthy:
+                    return f"chip {c} marked unhealthy", "hard"
+            # A dead ICI link strictly inside the allocation footprint
+            # breaks the gang's collectives (rings detour → catastrophic
+            # slowdown on a torus) — re-place if anywhere better exists.
+            if link_found is None:
+                for a, b in st.bad_links:
+                    if a in coord_set and b in coord_set:
+                        link_found = (
+                            f"ICI link {a}–{b} failed inside allocation",
+                            "link")
+                        break
+        return link_found
 
     def _better_placement_exists(self, gang: str,
                                  asg: GangAssignment) -> bool:
@@ -133,7 +144,7 @@ class FaultRecoveryController:
         — not from live member pods — so partially-completed or
         already-garbage-collected members can't skew the shape."""
         from kubegpu_tpu.allocator import GangRequest
-        from kubegpu_tpu.kubemeta import pod_mesh_axes
+        from kubegpu_tpu.kubemeta import pod_mesh_axes, pod_multislice
 
         if not asg.pods or not asg.pods[0].chips:
             return False
@@ -145,7 +156,10 @@ class FaultRecoveryController:
                 gang_name=gang, num_pods=len(asg.pods),
                 chips_per_pod=chips_per_pod,
                 mesh_axes=self.scheduler._sane_axes(
-                    axes, len(asg.pods) * chips_per_pod))
+                    axes, len(asg.pods) * chips_per_pod),
+                # a multislice gang's alternative may also be multislice
+                allow_multislice=bool(members)
+                and pod_multislice(members[0]))
         except ValueError:
             return False
         alloc = self.scheduler.allocator

@@ -1,0 +1,204 @@
+"""Multislice (DCN-spanning) gangs: a gang too big for any one slice
+splits across slices on its outermost mesh axis (SURVEY.md §6 comm-backend
+row: collectives ride ICI intra-slice, DCN inter-slice)."""
+
+import numpy as np
+import pytest
+
+from kubegpu_tpu.allocator import GangAllocator, GangRequest, SliceState
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, PodPhase, pod_allocation
+from kubegpu_tpu.tpuplugin.mock import MockBackend
+
+
+def build_slice(slice_type: str, slice_id: str) -> SliceState:
+    spec = MockBackend(slice_type, slice_id=slice_id).spec
+    advs = [MockBackend(slice_type, host_id=h, slice_id=slice_id).discover()
+            for h in range(spec.num_hosts)]
+    return SliceState.from_advertisements(advs)
+
+
+class TestMultisliceAllocator:
+    def test_splits_when_no_single_slice_fits(self):
+        """8 pods x 4 chips = 32 chips over two v5e-16s (16 each)."""
+        slices = [build_slice("v5e-16", "s0"), build_slice("v5e-16", "s1")]
+        req = GangRequest("g", num_pods=8, chips_per_pod=4,
+                          mesh_axes={"dp": 8, "tp": 4},
+                          allow_multislice=True)
+        asg = GangAllocator().find_assignment(slices, req)
+        assert asg is not None
+        assert set(asg.slice_ids) == {"s0", "s1"}
+        # contiguous worker halves per slice (outer axis partitions)
+        by_slice = {}
+        for p in asg.pods:
+            by_slice.setdefault(asg.pod_slice(p), []).append(p.pod_index)
+        assert sorted(map(sorted, by_slice.values())) == [
+            [0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_disabled_without_opt_in(self):
+        slices = [build_slice("v5e-16", "s0"), build_slice("v5e-16", "s1")]
+        req = GangRequest("g", num_pods=8, chips_per_pod=4,
+                          mesh_axes={"dp": 8, "tp": 4})
+        assert GangAllocator().find_assignment(slices, req) is None
+
+    def test_single_slice_still_preferred(self):
+        slices = [build_slice("v5e-64", "big"), build_slice("v5e-16", "sm")]
+        req = GangRequest("g", num_pods=8, chips_per_pod=4,
+                          mesh_axes={"dp": 8, "tp": 4},
+                          allow_multislice=True)
+        asg = GangAllocator().find_assignment(slices, req)
+        assert asg is not None
+        assert asg.slice_ids == ["big"]
+
+    def test_locality_counts_dcn_pairs_nonlocal(self):
+        """tp traffic stays ICI-local inside each slice; the dp rings
+        cross slices, so reported locality sits strictly between the
+        tp-only fraction and 1.0."""
+        slices = [build_slice("v5e-16", "s0"), build_slice("v5e-16", "s1")]
+        req = GangRequest("g", num_pods=8, chips_per_pod=4,
+                          mesh_axes={"dp": 8, "tp": 4},
+                          axis_weights={"dp": 1.0, "tp": 8.0},
+                          allow_multislice=True)
+        asg = GangAllocator().find_assignment(slices, req)
+        assert asg is not None
+        assert 0.5 < asg.locality < 1.0
+
+    def test_commit_and_rollback_span_slices(self):
+        slices = [build_slice("v5e-16", "s0"), build_slice("v5e-16", "s1")]
+        by_id = {s.slice_id: s for s in slices}
+        alloc = GangAllocator()
+        req = GangRequest("g", num_pods=8, chips_per_pod=4,
+                          mesh_axes={"dp": 8, "tp": 4},
+                          allow_multislice=True)
+        asg = alloc.find_assignment(slices, req)
+        alloc.commit(by_id, asg)
+        assert all(sum(s.used_millichips.values()) == 16000 for s in slices)
+        alloc.rollback(by_id, asg)
+        assert all(sum(s.used_millichips.values()) == 0 for s in slices)
+
+    def test_rollback_survives_vanished_slice(self):
+        slices = [build_slice("v5e-16", "s0"), build_slice("v5e-16", "s1")]
+        by_id = {s.slice_id: s for s in slices}
+        alloc = GangAllocator()
+        req = GangRequest("g", num_pods=8, chips_per_pod=4,
+                          mesh_axes={"dp": 8, "tp": 4},
+                          allow_multislice=True)
+        asg = alloc.find_assignment(slices, req)
+        alloc.commit(by_id, asg)
+        del by_id["s1"]   # all hosts of s1 died
+        alloc.rollback(by_id, asg)   # must not raise; frees s0's share
+        assert sum(by_id["s0"].used_millichips.values()) == 0
+
+
+class TestMultisliceCluster:
+    def _submit_gang(self, cl, size=8, chips=2, name="ms"):
+        cl.submit(*[
+            tpu_pod(f"{name}-{i}", chips=chips,
+                    gang=GangSpec(name=name, size=size, index=i),
+                    mesh_axes={"dp": size, "tp": chips},
+                    multislice=True, command=["x"])
+            for i in range(size)
+        ])
+
+    def test_gang_spans_two_slices_end_to_end(self):
+        """4 pods x 4 chips over two v4-8s (4 chips each): schedule,
+        annotate (per-pod slice ids), run, release."""
+        cl = SimCluster(["v4-8", "v4-8"])
+        self._submit_gang(cl, size=4, chips=2, name="ms")
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4
+        slice_ids = set()
+        workers = {}
+        for i in range(4):
+            alloc = pod_allocation(cl.api.get("Pod", f"ms-{i}"))
+            slice_ids.add(alloc.slice_id)
+            workers[i] = alloc.worker_id
+            assert alloc.num_workers == 4
+            assert alloc.coordinator_address
+        assert len(slice_ids) == 2
+        assert workers == {i: i for i in range(4)}
+        codes = cl.run_to_completion(timeout_s=30)
+        assert all(c == 0 for c in codes.values())
+        # chips released on both slices
+        for st in cl.scheduler.slices.values():
+            assert sum(st.used_millichips.values()) == 0
+        cl.close()
+
+    def test_restart_resync_rebuilds_multislice_gang(self):
+        from kubegpu_tpu.scheduler import DeviceScheduler
+        cl = SimCluster(["v4-8", "v4-8"])
+        self._submit_gang(cl, size=4, chips=2)
+        cl.step()
+        fresh = DeviceScheduler(cl.api)
+        used = sum(sum(st.used_millichips.values())
+                   for st in fresh.slices.values())
+        assert used == 8000
+        asg = fresh._committed["ms"]
+        assert len(asg.slice_ids) == 2
+        cl.close()
+
+    def test_host_failure_evicts_whole_multislice_gang(self):
+        cl = SimCluster(["v4-8", "v4-8"])
+        self._submit_gang(cl, size=4, chips=2)
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4
+        # kill one host of one slice → the WHOLE gang (both slices) evicts
+        victim_alloc = pod_allocation(cl.api.get("Pod", "ms-0"))
+        cl.fail_host(victim_alloc.node_name)
+        rec = cl.recovery.run_once()
+        assert "ms" in rec.evicted_gangs
+        for i in range(4):
+            assert cl.pod_phase(f"ms-{i}") == PodPhase.PENDING
+        cl.close()
+
+
+class TestMultisliceRealProcesses:
+    def test_dp_training_across_two_slices(self):
+        """The whole path with real JAX subprocesses: a dp=4 gang split
+        across two v4-8 slices forms one jax.distributed group (dp rings
+        crossing the slice boundary = the DCN tier in production)."""
+        cl = SimCluster(["v4-8", "v4-8"], real_processes=True,
+                        extra_env={"JAX_PLATFORMS": "cpu"})
+        cl.submit(*[
+            tpu_pod(f"ms-{i}", chips=2,
+                    gang=GangSpec(name="ms", size=4, index=i),
+                    mesh_axes={"dp": 4, "tp": 2}, multislice=True,
+                    command=["python", "-m",
+                             "kubegpu_tpu.workloads.programs.llama_pjit"],
+                    env={"LLAMA_STEPS": "1"})
+            for i in range(4)
+        ])
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4, result
+        codes = cl.run_to_completion(timeout_s=240)
+        assert all(codes.get(f"ms-{i}") == 0 for i in range(4)), codes
+        cl.close()
+
+
+class TestMultisliceFaultPrecedence:
+    def test_hard_fault_in_second_slice_wins_over_link_in_first(self):
+        """Review regression: a bad link in the primary slice must not
+        mask a DEAD host in the other slice — the gang must evict (hard),
+        never park as 'degraded' with pods bound to dead hardware."""
+        cl = SimCluster(["v4-8", "v4-8"])
+        cl.submit(*[
+            tpu_pod(f"ms-{i}", chips=2,
+                    gang=GangSpec(name="ms", size=4, index=i),
+                    mesh_axes={"dp": 4, "tp": 2}, multislice=True,
+                    command=["x"])
+            for i in range(4)
+        ])
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4
+        a0 = pod_allocation(cl.api.get("Pod", "ms-0"))   # primary slice
+        a2 = pod_allocation(cl.api.get("Pod", "ms-2"))   # the other one
+        assert a0.slice_id != a2.slice_id
+        # link fault INSIDE worker 0/1's footprint (primary, checked first)
+        cl.fail_link(a0.chips[0].coord, a0.chips[1].coord,
+                     slice_id=a0.slice_id)
+        # hard fault: the other slice's host dies
+        cl.fail_host(a2.node_name)
+        rec = cl.recovery.run_once()
+        assert "ms" in rec.evicted_gangs, rec
+        assert "ms" not in cl.recovery._degraded
+        cl.close()
