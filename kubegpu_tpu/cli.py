@@ -163,6 +163,8 @@ def _build_cluster(args, slices: list[str]) -> SimCluster:
     if getattr(args, "real", False):
         cfg.runtime.real_processes = True
         cfg.runtime.extra_env.setdefault("JAX_PLATFORMS", "cpu")
+    if getattr(args, "log_json", False):
+        cfg.obs.json_logs = True   # from_config consumes the obs section
     return SimCluster.from_config(cfg)
 
 
@@ -268,6 +270,8 @@ def main(argv: list[str] | None = None) -> int:
         p.add_argument("--real", action="store_true",
                        help="launch real workload subprocesses (JAX on CPU)")
         p.add_argument("--timeout", type=float, default=300.0)
+        p.add_argument("--log-json", action="store_true",
+                       help="structured JSON log lines on stderr")
         if with_file:
             p.add_argument("-f", "--file", required=True,
                            help="workload spec file (YAML/JSON)")

@@ -115,6 +115,12 @@ class SimCluster:
             raise NotImplementedError(
                 "libtpu backend needs real hardware; SimCluster is the "
                 "simulated control plane (use the mock backend)")
+        if cfg.obs.json_logs:
+            import logging
+
+            from kubegpu_tpu.obs import configure_logging
+            configure_logging(getattr(logging, cfg.obs.log_level.upper(),
+                                      logging.INFO))
         return cls(list(cfg.backend.slice_types), config=cfg)
 
     # -- lifecycle events: free resources when pods finish/disappear -----

@@ -54,6 +54,9 @@ class RuntimeConfig:
 @dataclass
 class ObsConfig:
     trace_capacity: int = 4096
+    # emit structured JSON log lines (obs/logging.py) to stderr
+    json_logs: bool = False
+    log_level: str = "info"
 
 
 @dataclass

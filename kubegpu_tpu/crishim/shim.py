@@ -12,7 +12,10 @@ import json
 from kubegpu_tpu.crishim.runtime import ContainerHandle, ContainerRuntime
 from kubegpu_tpu.kubemeta import FakeApiServer, Pod
 from kubegpu_tpu.kubemeta.codec import pod_allocation, pod_mesh_axes
+from kubegpu_tpu.obs import get_logger
 from kubegpu_tpu.tpuplugin.backend import DeviceBackend
+
+log = get_logger("crishim")
 
 
 class CriShim:
@@ -60,5 +63,8 @@ class CriShim:
                 # close the loop: the mesh the allocator optimized
                 # placement for IS the mesh the workload builds
                 env["KUBETPU_MESH_AXES"] = json.dumps(list(axes.items()))
+        log.info("create_container", pod=pod.name, node=self.node_name,
+                 chips=len(alloc.chips) if alloc else 0,
+                 worker_id=alloc.worker_id if alloc else None)
         return self.runtime.create_container(
             pod.name, spec.name, spec.command, env)

@@ -50,8 +50,10 @@ from kubegpu_tpu.kubemeta.codec import (
     node_advertisement,
 )
 from kubegpu_tpu.kubemeta.objects import GangSpec
-from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
+from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace, get_logger
 from kubegpu_tpu.tpuplugin.backend import NodeAdvertisement
+
+log = get_logger("scheduler")
 
 
 @dataclass
@@ -476,6 +478,9 @@ class DeviceScheduler:
             "slice": asg.slice_id, "locality": asg.locality,
             "score": asg.score,
             "nodes": sorted({p.node_name for p in asg.pods})})
+        log.info("schedule", gang=gang_name, slices=asg.slice_ids,
+                 pods=len(members), locality=round(asg.locality, 4),
+                 priority=priority)
 
     def _observe_latency(self, t0: float, gang: str, scheduled: bool) -> None:
         ms = (time.perf_counter() - t0) * 1e3
@@ -525,8 +530,8 @@ class DeviceScheduler:
         fits = False
         for victim in order:
             asg = self._committed[victim]
-            if asg.slice_id not in trial:
-                continue   # slice gone; eviction frees nothing here
+            if not any(sid in trial for sid in asg.slice_ids):
+                continue   # every slice gone; eviction frees nothing
             self.allocator.rollback(trial, asg)
             chosen.append(victim)
             if self.allocator.find_assignment(
@@ -575,6 +580,7 @@ class DeviceScheduler:
         pods = self.gang_member_pods(gang)
         self.trace.record("evict", gang=gang, detail={
             "reason": reason, "pods": sorted(p.name for p in pods)})
+        log.warning("evict", gang=gang, reason=reason, pods=len(pods))
         for pod in pods:
             try:
                 self.api.delete("Pod", pod.name,

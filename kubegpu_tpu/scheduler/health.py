@@ -171,9 +171,14 @@ class FaultRecoveryController:
             alloc.commit(slices, asg)
         if alt is None:
             return False
-        cur = {ch.coord for p in asg.pods for ch in p.chips}
-        new = {ch.coord for p in alt.pods for ch in p.chips}
-        return (alt.slice_id, new) != (asg.slice_id, cur)
+        # coords are slice-local, so compare (slice, coord) pairs — an
+        # untagged union would conflate colliding coords across slices of
+        # a multislice gang
+        cur = {(asg.pod_slice(p), ch.coord)
+               for p in asg.pods for ch in p.chips}
+        new = {(alt.pod_slice(p), ch.coord)
+               for p in alt.pods for ch in p.chips}
+        return new != cur
 
     def _gang_member_pods(self, gang: str) -> list[Pod]:
         return self.scheduler.gang_member_pods(gang)

@@ -13,6 +13,10 @@ Env knobs (set via pod spec env):
                  else dp over all devices
   LLAMA_CKPT_DIR if set, restore at start / save at end (params AND
                  optimizer state)
+  LLAMA_PROFILE_DIR
+                 if set, worker 0 captures a jax.profiler trace of the
+                 train steps there (view with tensorboard/xprof —
+                 SURVEY.md §6 tracing row)
 """
 
 from __future__ import annotations
@@ -112,13 +116,22 @@ def main() -> int:
     seq = 33
     tok_sharding = NamedSharding(mesh, fit_spec(mesh, P(("dp", "fsdp"),
                                                         None)))
+    profile_dir = os.environ.get("LLAMA_PROFILE_DIR")
+    profiling = bool(profile_dir) and env.worker_id == 0
+    if profiling:
+        jax.profiler.start_trace(profile_dir)
     losses = []
-    for i in range(start_step, start_step + steps):
-        tokens = (np.arange(batch * seq, dtype=np.int32)
-                  .reshape(batch, seq) * (i + 3)) % cfg.vocab_size
-        tokens = jax.device_put(jnp.asarray(tokens), tok_sharding)
-        params, opt_state, loss = step_fn(params, opt_state, tokens)
-        losses.append(float(loss))
+    try:
+        for i in range(start_step, start_step + steps):
+            tokens = (np.arange(batch * seq, dtype=np.int32)
+                      .reshape(batch, seq) * (i + 3)) % cfg.vocab_size
+            tokens = jax.device_put(jnp.asarray(tokens), tok_sharding)
+            with jax.profiler.StepTraceAnnotation("train", step_num=i):
+                params, opt_state, loss = step_fn(params, opt_state, tokens)
+            losses.append(float(loss))
+    finally:
+        if profiling:
+            jax.profiler.stop_trace()
 
     if ckpt_dir:
         import orbax.checkpoint as ocp

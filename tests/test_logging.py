@@ -1,0 +1,66 @@
+"""Structured JSON logging (SURVEY.md §6): machine-parseable lines from
+the real scheduling path."""
+
+import io
+import json
+import logging
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.obs.logging import configure, get_logger
+
+
+def drain(stream: io.StringIO) -> list[dict]:
+    return [json.loads(line) for line in stream.getvalue().splitlines()]
+
+
+class TestStructuredLogging:
+    def test_json_lines_shape(self):
+        stream = io.StringIO()
+        handler = configure(logging.DEBUG, stream)
+        try:
+            log = get_logger("testcomp")
+            log.info("hello", pod="p1", chips=4)
+            log.warning("uh-oh", reason="why")
+        finally:
+            logging.getLogger("kubetpu").removeHandler(handler)
+        events = drain(stream)
+        assert events[0]["event"] == "hello"
+        assert events[0]["component"] == "testcomp"
+        assert events[0]["level"] == "info"
+        assert events[0]["pod"] == "p1" and events[0]["chips"] == 4
+        assert isinstance(events[0]["ts"], float)
+        assert events[1]["level"] == "warning"
+
+    def test_configure_idempotent(self):
+        s1, s2 = io.StringIO(), io.StringIO()
+        configure(logging.INFO, s1)
+        handler = configure(logging.INFO, s2)  # replaces, no double lines
+        try:
+            get_logger("x").info("once")
+        finally:
+            logging.getLogger("kubetpu").removeHandler(handler)
+        assert s1.getvalue() == ""
+        assert len(drain(s2)) == 1
+
+    def test_scheduler_path_emits_events(self):
+        stream = io.StringIO()
+        handler = configure(logging.INFO, stream)
+        try:
+            cl = SimCluster(["v4-8"])
+            cl.submit(tpu_pod("p", chips=2, command=["x"]))
+            cl.step()
+            cl.close()
+        finally:
+            logging.getLogger("kubetpu").removeHandler(handler)
+        events = drain(stream)
+        kinds = {(e["component"], e["event"]) for e in events}
+        assert ("scheduler", "schedule") in kinds
+        assert ("crishim", "create_container") in kinds
+        sched = next(e for e in events if e["event"] == "schedule")
+        assert sched["gang"] == "p" and sched["pods"] == 1
+
+    def test_silent_by_default(self):
+        """No handler configured → nothing reaches stderr and nothing
+        raises (library-friendly: logging is opt-in)."""
+        log = get_logger("quiet")
+        log.info("nobody-listening", a=1)   # must not raise

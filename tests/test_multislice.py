@@ -2,20 +2,11 @@
 splits across slices on its outermost mesh axis (SURVEY.md §6 comm-backend
 row: collectives ride ICI intra-slice, DCN inter-slice)."""
 
-import numpy as np
-import pytest
-
-from kubegpu_tpu.allocator import GangAllocator, GangRequest, SliceState
+from kubegpu_tpu.allocator import GangAllocator, GangRequest
 from kubegpu_tpu.cluster import SimCluster, tpu_pod
 from kubegpu_tpu.kubemeta import GangSpec, PodPhase, pod_allocation
-from kubegpu_tpu.tpuplugin.mock import MockBackend
 
-
-def build_slice(slice_type: str, slice_id: str) -> SliceState:
-    spec = MockBackend(slice_type, slice_id=slice_id).spec
-    advs = [MockBackend(slice_type, host_id=h, slice_id=slice_id).discover()
-            for h in range(spec.num_hosts)]
-    return SliceState.from_advertisements(advs)
+from tests.test_allocator import make_slice as build_slice
 
 
 class TestMultisliceAllocator:
