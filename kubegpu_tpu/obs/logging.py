@@ -22,6 +22,12 @@ import logging
 import sys
 
 
+# Library etiquette: without this, an unconfigured tree leaks WARNING+
+# events to stderr as bare text via logging.lastResort (fields dropped).
+# configure() attaches the real JSON handler when logging is opted into.
+logging.getLogger("kubetpu").addHandler(logging.NullHandler())
+
+
 class JsonFormatter(logging.Formatter):
     """One JSON object per record; event fields ride in ``record.fields``."""
 

@@ -12,6 +12,12 @@ from kubegpu_tpu.scheduler.health import (
     FaultRecoveryController,
     RecoveryResult,
 )
+from kubegpu_tpu.scheduler.webhook import (
+    ExtenderHTTPServer,
+    ExtenderService,
+    policy_config,
+)
 
 __all__ = ["DeviceScheduler", "ScheduleResult", "FaultRecoveryController",
-           "RecoveryResult"]
+           "RecoveryResult", "ExtenderHTTPServer", "ExtenderService",
+           "policy_config"]

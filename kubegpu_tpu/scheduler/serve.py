@@ -1,0 +1,58 @@
+"""Standalone extender service: ``python -m kubegpu_tpu.scheduler.serve``.
+
+Binds the HTTP extender webhook (deploy/README.md §1) over a cluster
+built from the config tree — the mock backend in this environment, the
+same wiring a real deployment uses with a client-go-backed apiserver
+shim in place of the fake.  Prints the policy-config stanza to register
+with kube-scheduler, then serves until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    from kubegpu_tpu.cluster import SimCluster
+    from kubegpu_tpu.config import KubeTpuConfig
+    from kubegpu_tpu.scheduler.webhook import (
+        ExtenderHTTPServer,
+        policy_config,
+    )
+
+    ap = argparse.ArgumentParser(
+        prog="kubetpu-extender",
+        description="HTTP scheduler-extender webhook (kube-scheduler "
+        "filter/prioritize verbs)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8900)
+    ap.add_argument("--config", help="config file (JSON/YAML)")
+    ap.add_argument("--set", action="append", metavar="K.EY=VAL",
+                    help="dotted config override, repeatable")
+    ap.add_argument("--slices", nargs="+",
+                    help="override cluster slice types")
+    args = ap.parse_args(argv)
+
+    cfg = KubeTpuConfig.load(args.config, args.set or [])
+    if args.slices:
+        cfg.backend.slice_types = args.slices
+    cl = SimCluster.from_config(cfg)
+    srv = ExtenderHTTPServer(cl.scheduler, host=args.host,
+                             port=args.port).start()
+    print(f"extender listening on {srv.address}", file=sys.stderr)
+    print(json.dumps(policy_config(srv.address), indent=2))
+    try:
+        import threading
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+        cl.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,8 +59,14 @@ class TestStructuredLogging:
         sched = next(e for e in events if e["event"] == "schedule")
         assert sched["gang"] == "p" and sched["pods"] == 1
 
-    def test_silent_by_default(self):
+    def test_silent_by_default(self, capsys):
         """No handler configured → nothing reaches stderr and nothing
-        raises (library-friendly: logging is opt-in)."""
+        raises (library-friendly: logging is opt-in).  WARNING+ must not
+        leak through logging.lastResort either (NullHandler in place)."""
         log = get_logger("quiet")
-        log.info("nobody-listening", a=1)   # must not raise
+        log.info("nobody-listening", a=1)
+        log.warning("still-nobody", b=2)
+        log.error("even-errors", c=3)
+        captured = capsys.readouterr()
+        assert "still-nobody" not in captured.err
+        assert "even-errors" not in captured.err

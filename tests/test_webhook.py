@@ -1,0 +1,120 @@
+"""HTTP scheduler-extender webhook: the kube-scheduler wire contract
+(SURVEY.md §3 extender service / §4.2 filter→prioritize)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec
+from kubegpu_tpu.scheduler.webhook import (
+    ExtenderHTTPServer,
+    pod_from_doc,
+    pod_to_doc,
+    policy_config,
+)
+
+
+def post(url: str, payload) -> object:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def cluster_and_server():
+    cl = SimCluster(["v5e-16"])
+    srv = ExtenderHTTPServer(cl.scheduler).start()
+    yield cl, srv
+    srv.close()
+    cl.close()
+
+
+class TestPodDocRoundTrip:
+    def test_round_trip_preserves_scheduler_fields(self):
+        pod = tpu_pod("p", chips=4, mesh_axes={"dp": 2, "tp": 2},
+                      gang=GangSpec(name="g", size=2, index=0),
+                      priority=7, multislice=True, command=["x"])
+        back = pod_from_doc(pod_to_doc(pod))
+        assert back.name == "p"
+        assert back.spec.total_chips == 4
+        assert back.spec.priority == 7
+        assert back.metadata.annotations == pod.metadata.annotations
+
+
+class TestExtenderHTTP:
+    def test_filter_over_http(self, cluster_and_server):
+        cl, srv = cluster_and_server
+        nodes = [n.name for n in cl.api.list("Node")]
+        pod_doc = pod_to_doc(tpu_pod("p", chips=4, command=["x"]))
+        out = post(f"{srv.address}/kubetpu/filter",
+                   {"Pod": pod_doc, "NodeNames": nodes})
+        assert out["Error"] == ""
+        assert set(out["NodeNames"]) == set(nodes)
+        assert out["FailedNodes"] == {}
+
+    def test_filter_reports_infeasible_nodes(self, cluster_and_server):
+        cl, srv = cluster_and_server
+        # occupy one host's block, then ask for a full-host pod
+        cl.submit(tpu_pod("warm", chips=4, command=["x"]))
+        cl.step()
+        warm_node = cl.api.get("Pod", "warm").spec.node_name
+        nodes = [n.name for n in cl.api.list("Node")]
+        pod_doc = pod_to_doc(tpu_pod("p", chips=4, command=["x"]))
+        out = post(f"{srv.address}/kubetpu/filter",
+                   {"Pod": pod_doc, "NodeNames": nodes})
+        assert warm_node not in out["NodeNames"]
+        assert warm_node in out["FailedNodes"]
+
+    def test_prioritize_over_http(self, cluster_and_server):
+        cl, srv = cluster_and_server
+        nodes = [n.name for n in cl.api.list("Node")]
+        pod_doc = pod_to_doc(tpu_pod("p", chips=1, command=["x"]))
+        out = post(f"{srv.address}/kubetpu/prioritize",
+                   {"Pod": pod_doc, "NodeNames": nodes})
+        assert isinstance(out, list) and len(out) == len(nodes)
+        for entry in out:
+            assert entry["Host"] in nodes
+            assert 0 <= entry["Score"] <= 10
+
+    def test_unknown_verb_404(self, cluster_and_server):
+        _, srv = cluster_and_server
+        req = urllib.request.Request(
+            f"{srv.address}/kubetpu/nope", data=b"{}", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 404
+
+    def test_malformed_body_reports_error_field(self, cluster_and_server):
+        _, srv = cluster_and_server
+        req = urllib.request.Request(
+            f"{srv.address}/kubetpu/filter", data=b"not json",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        assert out["Error"]
+        assert out["NodeNames"] == []
+
+    def test_malformed_prioritize_returns_500(self, cluster_and_server):
+        """prioritize's contract is a bare HostPriorityList with no Error
+        slot — failures must surface at the HTTP level, not as an object
+        the client can't unmarshal."""
+        _, srv = cluster_and_server
+        req = urllib.request.Request(
+            f"{srv.address}/kubetpu/prioritize", data=b"not json",
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 500
+
+
+class TestPolicyConfig:
+    def test_stanza_shape(self):
+        cfg = policy_config("http://1.2.3.4:8900")
+        ext = cfg["extenders"][0]
+        assert ext["urlPrefix"] == "http://1.2.3.4:8900/kubetpu"
+        assert ext["filterVerb"] == "filter"
+        assert ext["prioritizeVerb"] == "prioritize"
