@@ -17,6 +17,7 @@ Fractional requests (millitpu < 1000) bin-pack onto partially-used chips
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 from kubegpu_tpu.kubemeta.codec import AllocatedChip, Allocation
@@ -406,8 +407,32 @@ def _block_sequences(topo: TpuTopology,
     return seqs
 
 
+_block_orders_memo: dict = {}
+
+
 def _block_orders(topo: TpuTopology, placement: Placement,
                   ring_span: int | None = None) -> list[list[Coord]]:
+    """Memoizing wrapper over :func:`_block_orders_uncached` — pure
+    geometry, so results are shared across slices of the same topology
+    shape and across scheduling passes (the same placements recur
+    constantly under churn).  Callers must not mutate the returned
+    orders.  The native-path flag is part of the key so the parity tests
+    compare real computations, not cache hits."""
+    key = (topo.spec.name, topo.spec.mesh_shape, topo.spec.wrap,
+           topo.spec.host_block, placement, ring_span,
+           bool(os.environ.get("KUBETPU_NO_NATIVE")))
+    hit = _block_orders_memo.get(key)
+    if hit is None:
+        hit = _block_orders_uncached(topo, placement, ring_span)
+        if len(_block_orders_memo) >= 8192:
+            _block_orders_memo.clear()
+        _block_orders_memo[key] = hit
+    return hit
+
+
+def _block_orders_uncached(topo: TpuTopology, placement: Placement,
+                           ring_span: int | None = None
+                           ) -> list[list[Coord]]:
     """Chip orders built from block sequences.  With ``ring_span`` (chips
     in the workload's fastest logical axis), blocks are grouped so each
     ring's span of blocks is closed into a physical cycle — e.g. a tp=16

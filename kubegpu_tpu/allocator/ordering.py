@@ -18,6 +18,7 @@ reports, and the argmax wins.
 
 from __future__ import annotations
 
+import functools
 import itertools
 
 from kubegpu_tpu.topology.locality import (
@@ -28,6 +29,9 @@ from kubegpu_tpu.topology.locality import (
 )
 from kubegpu_tpu.topology.mesh import Coord, TpuTopology
 from kubegpu_tpu.topology.slices import Placement
+
+
+_eval_order_memo: dict = {}
 
 
 def evaluate_order(
@@ -41,15 +45,32 @@ def evaluate_order(
 
     ``bad_links`` (failed ICI links) force the slow Python path — faults
     are rare, and correctness of avoiding a dead link beats the native
-    fast path's speed.
+    fast path's speed.  The fault-free path is pure geometry and
+    memoized (same orders recur across slices and passes); the
+    native-path flag keys the memo so parity tests compare real runs.
     """
+    import os
+
     from kubegpu_tpu.allocator import _native
 
     axis_weights = resolve_axis_weights(axes, axis_weights)
     if not bad_links:
+        key = (topo.spec.name, topo.spec.mesh_shape, topo.spec.wrap,
+               tuple(order), tuple(axes.items()),
+               tuple(sorted(axis_weights.items())),
+               bool(os.environ.get("KUBETPU_NO_NATIVE")))
+        hit = _eval_order_memo.get(key)
+        if hit is not None:
+            return hit
         native = _native.eval_order_native(topo, order, axes, axis_weights)
-        if native is not None:
-            return native
+        if native is None:
+            native = ici_locality(
+                topo, traffic_pairs_for_mesh_axes(order, axes,
+                                                  axis_weights))
+        if len(_eval_order_memo) >= 16384:
+            _eval_order_memo.clear()
+        _eval_order_memo[key] = native
+        return native
     tm = traffic_pairs_for_mesh_axes(order, axes, axis_weights)
     return ici_locality(topo, tm, bad_links)
 
@@ -134,7 +155,11 @@ def _closed_cycle_orders(placement: Placement) -> list[list[Coord]]:
     return orders
 
 
+@functools.lru_cache(maxsize=4096)
 def candidate_orders(placement: Placement) -> list[list[Coord]]:
+    """Pure geometry of a (frozen, hashable) placement — memoized because
+    the same placements recur across slices and scheduling passes.
+    Callers must not mutate the returned orders."""
     seen: set[tuple] = set()
     out: list[list[Coord]] = []
     for o in (_grid_orders(placement) + _snake_orders(placement)

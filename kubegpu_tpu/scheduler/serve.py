@@ -28,6 +28,11 @@ def main(argv: list[str] | None = None) -> int:
         "filter/prioritize verbs)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8900)
+    ap.add_argument("--advertise-url",
+                    help="reachable URL for the printed policy stanza "
+                    "(e.g. the Service DNS name); defaults to the bind "
+                    "address, or the kube-system Service name when "
+                    "binding 0.0.0.0")
     ap.add_argument("--config", help="config file (JSON/YAML)")
     ap.add_argument("--set", action="append", metavar="K.EY=VAL",
                     help="dotted config override, repeatable")
@@ -42,7 +47,12 @@ def main(argv: list[str] | None = None) -> int:
     srv = ExtenderHTTPServer(cl.scheduler, host=args.host,
                              port=args.port).start()
     print(f"extender listening on {srv.address}", file=sys.stderr)
-    print(json.dumps(policy_config(srv.address), indent=2))
+    # the stanza must carry an address kube-scheduler can REACH — the
+    # bind address is wrong for 0.0.0.0 (that's kube-scheduler's own host)
+    advertise = args.advertise_url or (
+        f"http://kubetpu-extender.kube-system.svc:{args.port}"
+        if args.host == "0.0.0.0" else srv.address)
+    print(json.dumps(policy_config(advertise), indent=2))
     try:
         import threading
         threading.Event().wait()
