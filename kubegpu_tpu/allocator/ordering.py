@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import os
 
 from kubegpu_tpu.topology.locality import (
     TrafficModel,
@@ -49,8 +50,6 @@ def evaluate_order(
     memoized (same orders recur across slices and passes); the
     native-path flag keys the memo so parity tests compare real runs.
     """
-    import os
-
     from kubegpu_tpu.allocator import _native
 
     axis_weights = resolve_axis_weights(axes, axis_weights)

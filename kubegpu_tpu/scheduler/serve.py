@@ -49,8 +49,9 @@ def main(argv: list[str] | None = None) -> int:
     print(f"extender listening on {srv.address}", file=sys.stderr)
     # the stanza must carry an address kube-scheduler can REACH — the
     # bind address is wrong for 0.0.0.0 (that's kube-scheduler's own host)
-    advertise = args.advertise_url or (
-        f"http://kubetpu-extender.kube-system.svc:{args.port}"
+    bound_port = srv.address.rsplit(":", 1)[1]   # actual port (ephemeral
+    advertise = args.advertise_url or (          # binds resolve to real)
+        f"http://kubetpu-extender.kube-system.svc:{bound_port}"
         if args.host == "0.0.0.0" else srv.address)
     print(json.dumps(policy_config(advertise), indent=2))
     try:

@@ -1,0 +1,149 @@
+"""Control-plane fuzz: random op sequences against the full SimCluster
+with invariants checked continuously (SURVEY.md §5 property testing,
+extended from the allocator to the whole system — the interactions of
+priority preemption, backfill, multislice, fractional co-tenancy, and
+fault recovery are where double-booking bugs would hide)."""
+
+import random
+
+import pytest
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import (
+    GangSpec,
+    NotFound,
+    PodPhase,
+    pod_allocation,
+)
+from kubegpu_tpu.tpuplugin.backend import MILLICHIPS_PER_CHIP
+
+
+def annotation_occupancy(cl) -> dict:
+    """(slice_id, coord) → millichips, summed over live allocations —
+    the annotation truth the scheduler cache must agree with."""
+    per_coord: dict = {}
+    for pod in cl.api.list("Pod"):
+        if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+            continue
+        alloc = pod_allocation(pod)
+        if alloc is None:
+            continue
+        for ch in alloc.chips:
+            key = (alloc.slice_id, ch.coord)
+            per_coord[key] = per_coord.get(key, 0) + ch.millichips
+    return per_coord
+
+
+def check_invariants(cl) -> None:
+    per_coord = annotation_occupancy(cl)
+    # 1. no coord is ever over-committed (annotation truth)
+    for key, used in per_coord.items():
+        assert 0 < used <= MILLICHIPS_PER_CHIP, (key, used)
+    # 2. the in-memory cache never goes negative or over
+    for sid, st in cl.scheduler.slices.items():
+        for coord, used in st.used_millichips.items():
+            assert 0 <= used <= MILLICHIPS_PER_CHIP, (sid, coord, used)
+    # 3. gang atomicity: bound/running members all carry allocations
+    for pod in cl.api.list("Pod"):
+        if pod.status.phase in (PodPhase.SCHEDULED, PodPhase.RUNNING):
+            if pod.spec.total_chips or pod.spec.total_millitpu:
+                assert pod_allocation(pod) is not None, pod.name
+        elif pod.status.phase == PodPhase.PENDING:
+            assert pod_allocation(pod) is None, pod.name
+
+
+def check_sync_convergence(cl) -> None:
+    """Restart recovery: a full re-sync must reproduce exactly the
+    annotation-derived occupancy for every live slice."""
+    per_coord = annotation_occupancy(cl)
+    cl.scheduler.sync()
+    for sid, st in cl.scheduler.slices.items():
+        for coord in {ch.coord for ch in st.topo.chips}:
+            expect = per_coord.get((sid, coord), 0)
+            got = st.used_millichips.get(coord, 0)
+            assert got == expect, (sid, coord, got, expect)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_control_plane_fuzz(seed):
+    rng = random.Random(seed)
+    cl = SimCluster(["v5e-16", "v4-8", "v4-8"])
+    counter = 0
+    hosts = [a.node_name for a in cl.agents]
+    down_hosts: set = set()
+    bad_chips: set = set()
+
+    def submit_random():
+        nonlocal counter
+        counter += 1
+        kind = rng.random()
+        prio = rng.choice([0, 0, 0, 5, 10])
+        if kind < 0.15:
+            cl.submit(tpu_pod(f"f{counter}", millitpu=rng.choice([300, 500]),
+                              command=["x"], priority=prio))
+        elif kind < 0.4:
+            cl.submit(tpu_pod(f"s{counter}", chips=rng.choice([1, 2, 4]),
+                              command=["x"], priority=prio))
+        else:
+            size = rng.choice([2, 4, 8])
+            chips = rng.choice([1, 2])
+            ms = rng.random() < 0.5
+            pods = [tpu_pod(f"g{counter}-{k}", chips=chips,
+                            gang=GangSpec(name=f"g{counter}", size=size,
+                                          index=k),
+                            mesh_axes={"dp": size, "tp": chips},
+                            multislice=ms, command=["x"], priority=prio)
+                    for k in range(size)]
+            if rng.random() < 0.25:
+                pods = pods[:-1]   # trickle: last member arrives later (or
+                #                    never — grace expiry must unblock)
+            cl.submit(*pods)
+
+    for _ in range(150):
+        op = rng.random()
+        if op < 0.45:
+            submit_random()
+        elif op < 0.6:
+            pods = [p for p in cl.api.list("Pod")]
+            if pods:
+                victim = rng.choice(pods)
+                try:
+                    cl.api.delete("Pod", victim.name,
+                                  namespace=victim.metadata.namespace)
+                except NotFound:
+                    pass
+        elif op < 0.7:
+            h = rng.choice(hosts)
+            if h in down_hosts:
+                cl.restore_host(h)
+                down_hosts.discard(h)
+            elif len(down_hosts) < 2:
+                cl.fail_host(h)
+                down_hosts.add(h)
+        elif op < 0.78:
+            h = rng.choice(hosts)
+            if h not in down_hosts:
+                idx = rng.randrange(2)
+                key = (h, idx)
+                if key in bad_chips:
+                    cl.heal_chip(h, idx)
+                    bad_chips.discard(key)
+                else:
+                    cl.fail_chip(h, idx)
+                    bad_chips.add(key)
+        else:
+            cl.step()
+            cl.reap(timeout=0)
+        check_invariants(cl)
+
+    # settle: heal everything, drain the queue, re-check + convergence
+    for h in list(down_hosts):
+        cl.restore_host(h)
+    for (h, idx) in list(bad_chips):
+        cl.heal_chip(h, idx)
+    for _ in range(8):
+        cl.step()
+        cl.reap(timeout=0)
+    check_invariants(cl)
+    check_sync_convergence(cl)
+    cl.close()
