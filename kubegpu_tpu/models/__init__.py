@@ -21,9 +21,16 @@ from kubegpu_tpu.models.moe import (
     moe_init,
     moe_param_specs,
 )
+from kubegpu_tpu.models.vit import (
+    ViTConfig,
+    vit_forward,
+    vit_init,
+    vit_param_specs,
+)
 
 __all__ = [
     "LlamaConfig", "llama_forward", "llama_init", "llama_param_specs",
     "MoEConfig", "moe_forward", "moe_init", "moe_param_specs",
+    "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
 ]

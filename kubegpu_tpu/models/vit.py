@@ -1,0 +1,196 @@
+"""Vision Transformer — the image-classification family beyond ResNet
+(TPU-native addition; the reference's example/ hosts workloads, it ships
+no models — SURVEY.md §3).
+
+Same TPU-first construction as the Llama decoder:
+- encoder blocks stored *stacked* ``[L, ...]`` and run with ``lax.scan``
+  (one traced block, O(1) compile time at any depth);
+- patch embedding as a single reshape+matmul (the conv is a matmul over
+  flattened patches — MXU-friendly, no conv lowering needed);
+- bidirectional attention through the shared flash/XLA kernel
+  (``causal=False``);
+- megatron-style PartitionSpec tree (dp/fsdp batch, tp on heads/mlp), so
+  the same pjit wiring the Llama workload uses serves ViT unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubegpu_tpu.ops import attention
+from kubegpu_tpu.parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    n_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: str = "bfloat16"
+    attn_impl: str = "auto"   # auto | pallas | xla
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def base_16(cls) -> "ViTConfig":
+        """ViT-B/16."""
+        return cls()
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        base = cls(image_size=32, patch_size=8, n_classes=10, d_model=64,
+                   n_layers=2, n_heads=4, d_ff=128, dtype="float32",
+                   attn_impl="xla")
+        return replace(base, **kw)
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def vit_init(key: jax.Array, cfg: ViTConfig) -> dict:
+    patch_dim = cfg.patch_size * cfg.patch_size * 3
+    ks = jax.random.split(key, 8)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.jdtype)
+
+    L = cfg.n_layers
+    return {
+        "patch_embed": dense(ks[0], (patch_dim, cfg.d_model), patch_dim),
+        "cls_token": jnp.zeros((1, 1, cfg.d_model), cfg.jdtype),
+        "pos_embed": (jax.random.normal(
+            ks[1], (1, cfg.n_patches + 1, cfg.d_model), jnp.float32)
+            * 0.02).astype(cfg.jdtype),
+        "layers": {
+            "ln1_scale": jnp.ones((L, cfg.d_model), cfg.jdtype),
+            "ln1_bias": jnp.zeros((L, cfg.d_model), cfg.jdtype),
+            "wqkv": dense(ks[2], (L, cfg.d_model, 3 * cfg.d_model),
+                          cfg.d_model),
+            "wo": dense(ks[3], (L, cfg.d_model, cfg.d_model), cfg.d_model),
+            "ln2_scale": jnp.ones((L, cfg.d_model), cfg.jdtype),
+            "ln2_bias": jnp.zeros((L, cfg.d_model), cfg.jdtype),
+            "w_up": dense(ks[4], (L, cfg.d_model, cfg.d_ff), cfg.d_model),
+            "b_up": jnp.zeros((L, cfg.d_ff), cfg.jdtype),
+            "w_down": dense(ks[5], (L, cfg.d_ff, cfg.d_model), cfg.d_ff),
+            "b_down": jnp.zeros((L, cfg.d_model), cfg.jdtype),
+        },
+        "final_ln_scale": jnp.ones((cfg.d_model,), cfg.jdtype),
+        "final_ln_bias": jnp.zeros((cfg.d_model,), cfg.jdtype),
+        "head": dense(ks[6], (cfg.d_model, cfg.n_classes), cfg.d_model),
+    }
+
+
+def vit_param_specs(cfg: ViTConfig) -> dict:
+    """dp/fsdp on batch (activations), tp on heads/mlp dims."""
+    return {
+        "patch_embed": P(None, "tp"),
+        "cls_token": P(None, None, None),
+        "pos_embed": P(None, None, None),
+        "layers": {
+            "ln1_scale": P(None, None),
+            "ln1_bias": P(None, None),
+            "wqkv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "ln2_scale": P(None, None),
+            "ln2_bias": P(None, None),
+            "w_up": P(None, "fsdp", "tp"),
+            "b_up": P(None, "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+            "b_down": P(None, None),
+        },
+        "final_ln_scale": P(None),
+        "final_ln_bias": P(None),
+        "head": P("fsdp", "tp"),
+    }
+
+
+def _layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return out.astype(x.dtype) * scale + bias
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, 3] → [B, N, patch*patch*3] row-major patches."""
+    b, h, w, c = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def vit_forward(params: dict, images: jax.Array, cfg: ViTConfig,
+                mesh: Mesh | None = None) -> jax.Array:
+    """images [B, H, W, 3] → class logits [B, n_classes] (f32)."""
+    b = images.shape[0]
+    hd = cfg.head_dim
+    x = patchify(images.astype(cfg.jdtype), cfg.patch_size) \
+        @ params["patch_embed"]
+    cls = jnp.broadcast_to(params["cls_token"], (b, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"]
+    x = constrain(x, mesh, ("dp", "fsdp"), None, None)
+    t = x.shape[1]
+
+    def block(x, lp):
+        h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
+        qkv = (h @ lp["wqkv"]).reshape(b, t, 3, cfg.n_heads, hd)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        o = attention(q, k, v, causal=False, impl=cfg.attn_impl)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.d_model)
+        o = constrain(o, mesh, ("dp", "fsdp"), None, "tp")
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        h = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
+        up = jax.nn.gelu(h @ lp["w_up"] + lp["b_up"])
+        up = constrain(up, mesh, ("dp", "fsdp"), None, "tp")
+        x = x + (up @ lp["w_down"] + lp["b_down"]).astype(x.dtype)
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = _layernorm(x[:, 0], params["final_ln_scale"],
+                   params["final_ln_bias"])
+    return (x @ params["head"]).astype(jnp.float32)
+
+
+def vit_loss(params: dict, images: jax.Array, labels: jax.Array,
+             cfg: ViTConfig, mesh: Mesh | None = None) -> jax.Array:
+    logits = vit_forward(params, images, cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+
+def make_vit_train_step(cfg: ViTConfig, optimizer,
+                        mesh: Mesh | None = None):
+    """(params, opt_state, images, labels) → (params, opt_state, loss).
+    Reuses the shared train-step machinery (grad/update/apply — the same
+    hook the MoE step plugs its loss into)."""
+    from kubegpu_tpu.models.llama import make_train_step
+
+    def loss_fn(params, batch, _cfg, _mesh):
+        images, labels = batch
+        return vit_loss(params, images, labels, _cfg, _mesh)
+
+    base = make_train_step(cfg, optimizer, mesh, loss_fn=loss_fn)
+
+    def step(params, opt_state, images, labels):
+        return base(params, opt_state, (images, labels))
+
+    return step

@@ -1,0 +1,69 @@
+"""ViT training workload — image-classification family (beyond the
+BASELINE ResNet), single- or multi-worker via the injected TPU env.
+
+Env knobs:
+  VIT_PRESET  tiny (default) | b16
+  VIT_STEPS   train steps (default 4)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    from kubegpu_tpu.workloads.programs.distributed import init_from_env
+
+    env = init_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubegpu_tpu.models.vit import (
+        ViTConfig, make_vit_train_step, vit_init, vit_param_specs,
+    )
+    from kubegpu_tpu.parallel import make_mesh, named_sharding_tree
+    from kubegpu_tpu.parallel.sharding import fit_spec
+
+    preset = os.environ.get("VIT_PRESET", "tiny")
+    steps = max(1, int(os.environ.get("VIT_STEPS", "4")))
+    cfg = ViTConfig.base_16() if preset == "b16" else ViTConfig.tiny()
+    n = jax.device_count()
+    mesh = make_mesh({"dp": n})
+
+    params = jax.device_put(
+        vit_init(jax.random.PRNGKey(0), cfg),
+        named_sharding_tree(mesh, vit_param_specs(cfg)))
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_vit_train_step(cfg, opt, mesh),
+                   donate_argnums=(0, 1))
+    batch = max(8, n)
+    sh = NamedSharding(mesh, fit_spec(mesh, P(("dp", "fsdp"))))
+    # one FIXED batch: the loss-decrease gate below is only meaningful
+    # when successive losses measure the same data
+    images = jax.device_put(jax.random.uniform(
+        jax.random.PRNGKey(0),
+        (batch, cfg.image_size, cfg.image_size, 3)), sh)
+    labels = jax.device_put(
+        jnp.arange(batch, dtype=jnp.int32) % cfg.n_classes, sh)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+        losses.append(float(loss))
+
+    if env.worker_id == 0:
+        print(f"vit: preset={preset} devices={n} "
+              f"losses={[round(l, 4) for l in losses]}")
+    if not all(np.isfinite(losses)) or (
+            len(losses) > 1 and not losses[-1] < losses[0]):
+        print("FAIL: loss not improving", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
