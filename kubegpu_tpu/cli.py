@@ -47,12 +47,24 @@ def load_spec_file(path: str) -> dict:
     return load_structured_file(path)
 
 
+def quotas_from_spec(spec: dict) -> dict[str, dict]:
+    """namespace → {chips, millitpu} from the spec's ``quotas`` section."""
+    out = {}
+    for ns, q in (spec.get("quotas") or {}).items():
+        out[str(ns)] = {
+            "chips": int(q["chips"]) if "chips" in q else None,
+            "millitpu": int(q["millitpu"]) if "millitpu" in q else None,
+        }
+    return out
+
+
 def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
     """(pods, slice_types) from a parsed spec file."""
     slices = list((spec.get("cluster") or {}).get("slices", ["v4-8"]))
     pods = []
     for entry in spec.get("pods", []):
         name = entry["name"]
+        namespace = str(entry.get("namespace", "default"))
         gang = entry.get("gang")
         chips = int(entry.get("chips", 0))
         millitpu = int(entry.get("millitpu", 0))
@@ -66,7 +78,8 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
         if gang is None:
             pods.append(tpu_pod(name, chips=chips, millitpu=millitpu,
                                 mesh_axes=axes, command=command, env=env,
-                                priority=priority, multislice=multislice))
+                                priority=priority, multislice=multislice,
+                                namespace=namespace))
             continue
         if isinstance(gang, int):
             gang = {"size": gang}
@@ -77,7 +90,8 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
                 f"{name}-{i}", chips=chips, millitpu=millitpu,
                 gang=GangSpec(name=gname, size=size, index=i),
                 mesh_axes=axes, command=command, env=env,
-                priority=priority, multislice=multislice))
+                priority=priority, multislice=multislice,
+                namespace=namespace))
     return pods, slices
 
 
@@ -175,6 +189,8 @@ def cmd_apply(args) -> int:
         print("no pods in spec", file=sys.stderr)
         return 2
     cl = _build_cluster(args, args.slices or slices)
+    for ns, q in quotas_from_spec(spec).items():
+        cl.set_quota(ns, chips=q["chips"], millitpu=q["millitpu"])
     cl.submit(*pods)
     if args.schedule_only:
         cl.step()

@@ -48,10 +48,11 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
             command: list[str] | None = None,
             env: dict[str, str] | None = None,
             priority: int = 0,
-            multislice: bool = False) -> Pod:
+            multislice: bool = False,
+            namespace: str = "default") -> Pod:
     """Pod-spec builder — the user surface (reference: example/ YAML)."""
     pod = Pod(
-        metadata=ObjectMeta(name=name),
+        metadata=ObjectMeta(name=name, namespace=namespace),
         spec=PodSpec(containers=[ContainerSpec(
             name="main",
             command=command or [],
@@ -139,6 +140,20 @@ class SimCluster:
     def submit(self, *pods: Pod) -> None:
         for p in pods:
             self.api.create("Pod", p)
+
+    def set_quota(self, namespace: str, chips: int | None = None,
+                  millitpu: int | None = None) -> None:
+        """Create/replace the namespace's device quota (k8s ResourceQuota
+        parity — the scheduler denies asks that would exceed it)."""
+        from kubegpu_tpu.kubemeta import NotFound, Quota, QuotaSpec
+
+        try:
+            self.api.delete("Quota", "quota", namespace=namespace)
+        except NotFound:
+            pass
+        self.api.create("Quota", Quota(
+            metadata=ObjectMeta(name="quota", namespace=namespace),
+            spec=QuotaSpec(tpu_chips=chips, millitpu=millitpu)))
 
     def step(self):
         """One control-plane tick: recover from faults, schedule pending,

@@ -18,6 +18,8 @@ from kubegpu_tpu.kubemeta.objects import (
     Pod,
     PodPhase,
     PodSpec,
+    Quota,
+    QuotaSpec,
     ResourceRequests,
 )
 from kubegpu_tpu.kubemeta.codec import (
@@ -51,7 +53,7 @@ from kubegpu_tpu.kubemeta.controlplane import (
 
 __all__ = [
     "ContainerSpec", "GangSpec", "Node", "ObjectMeta", "Pod", "PodPhase",
-    "PodSpec", "ResourceRequests",
+    "PodSpec", "Quota", "QuotaSpec", "ResourceRequests",
     "ALLOCATE_FROM_KEY", "DEVICE_INFO_KEY", "GANG_KEY", "MESH_AXES_KEY",
     "AllocatedChip", "Allocation", "advertise_on_node",
     "allocation_from_annotation", "allocation_to_annotation",

@@ -44,7 +44,8 @@ class _Store:
 class FakeApiServer:
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._stores: dict[str, _Store] = {"Pod": _Store(), "Node": _Store()}
+        self._stores: dict[str, _Store] = {
+            "Pod": _Store(), "Node": _Store(), "Quota": _Store()}
         self._watchers: list[Callable[[WatchEvent], None]] = []
         self._rv = 0
 
@@ -84,13 +85,15 @@ class FakeApiServer:
             return store.objects[key].clone()
 
     def list(self, kind: str, label_selector: dict[str, str] | None = None,
-             *, node_name: str | None = None, phase=None):
-        """``node_name``/``phase`` are field selectors (k8s
-        ``spec.nodeName=...``/``status.phase=...``): filtering happens
-        BEFORE the per-object copy, so a node agent asking for its own
-        scheduled pods doesn't pay for cloning the whole cluster.
-        ``phase`` accepts one PodPhase or a tuple of them.  Both are
-        Pod-only selectors."""
+             *, node_name: str | None = None, phase=None,
+             namespace: str | None = None):
+        """``node_name``/``phase``/``namespace`` are field selectors (k8s
+        ``spec.nodeName=...``/``status.phase=...``/namespace scoping):
+        filtering happens BEFORE the per-object copy, so a node agent
+        asking for its own scheduled pods doesn't pay for cloning the
+        whole cluster.  ``phase`` accepts one PodPhase or a tuple of
+        them.  node_name/phase are Pod-only selectors; namespace works
+        for any kind."""
         if (node_name is not None or phase is not None) and kind != "Pod":
             raise ValueError(
                 f"node_name/phase are Pod field selectors (kind={kind})")
@@ -103,6 +106,9 @@ class FakeApiServer:
                     obj.metadata.labels.get(k) != v
                     for k, v in label_selector.items()
                 ):
+                    continue
+                if namespace is not None \
+                        and obj.metadata.namespace != namespace:
                     continue
                 if node_name is not None \
                         and obj.spec.node_name != node_name:

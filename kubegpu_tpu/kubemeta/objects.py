@@ -157,6 +157,31 @@ class Pod:
 
 
 @dataclass
+class QuotaSpec:
+    """Namespace device budget — k8s ResourceQuota parity for the two
+    TPU resources.  ``None`` = unlimited for that resource."""
+    tpu_chips: int | None = None
+    millitpu: int | None = None
+
+
+@dataclass
+class Quota:
+    """Namespaced quota object (one per namespace; the apiserver keys by
+    namespace/name, conventionally name='quota')."""
+    metadata: ObjectMeta
+    spec: QuotaSpec = field(default_factory=QuotaSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def clone(self) -> "Quota":
+        return Quota(metadata=self.metadata.clone(),
+                     spec=QuotaSpec(tpu_chips=self.spec.tpu_chips,
+                                    millitpu=self.spec.millitpu))
+
+
+@dataclass
 class NodeStatus:
     ready: bool = True
 

@@ -410,11 +410,76 @@ class DeviceScheduler:
         self.metrics.inc("schedule_invalid")
         self.trace.record("invalid", gang=gang, detail={"reason": reason})
 
+    def _quota_violation(self, members: list[Pod],
+                         req: GangRequest) -> str | None:
+        """Namespace ResourceQuota check (k8s parity): would admitting
+        this gang push the namespace's LIVE device usage past its Quota
+        object?  Usage is computed from annotation truth, so it survives
+        scheduler restarts like everything else.  Returns the human
+        reason, or None when within budget."""
+        from kubegpu_tpu.kubemeta import NotFound
+
+        ns = members[0].metadata.namespace
+        try:
+            quota = self.api.get("Quota", "quota", namespace=ns)
+        except NotFound:
+            return None   # no quota object → unlimited
+        ask_chips = req.total_chips
+        ask_milli = req.num_pods * req.millitpu_per_pod
+        used_chips = used_milli = 0
+        # allocations only exist on bound/running pods — field-select
+        # before the apiserver's per-object clone
+        for pod in self.api.list("Pod", namespace=ns,
+                                 phase=(PodPhase.SCHEDULED,
+                                        PodPhase.RUNNING)):
+            alloc = pod_allocation(pod)
+            if alloc is None:
+                continue
+            for ch in alloc.chips:
+                if ch.millichips >= 1000:
+                    used_chips += 1
+                else:
+                    used_milli += ch.millichips
+        limit_c = quota.spec.tpu_chips
+        limit_m = quota.spec.millitpu
+        if limit_c is not None and used_chips + ask_chips > limit_c:
+            return (f"namespace {ns} chip quota: {used_chips} used + "
+                    f"{ask_chips} requested > {limit_c}")
+        if limit_m is not None and used_milli + ask_milli > limit_m:
+            return (f"namespace {ns} millitpu quota: {used_milli} used + "
+                    f"{ask_milli} requested > {limit_m}")
+        return None
+
     def _schedule_gang(self, gang_name: str, members: list[Pod],
                        req: GangRequest, result: ScheduleResult,
                        priority: int = 0,
                        precomputed: GangAssignment | None = None) -> None:
         t0 = time.perf_counter()
+        quota_reason = self._quota_violation(members, req)
+        if quota_reason is not None \
+                and any(p < priority for p in self._gang_priority.values()):
+            # intra-tenant priority: evict the namespace's own
+            # lower-priority gangs to free quota room (capacity preemption
+            # alone never fires here — the quota gate precedes placement)
+            victims = self._plan_quota_preemption(
+                members[0].metadata.namespace, req, priority)
+            if victims:
+                for victim in victims:
+                    self.metrics.inc("gangs_preempted")
+                    self.evict_gang(
+                        victim,
+                        f"quota-preempted by {gang_name} (priority "
+                        f"{priority} > "
+                        f"{self._gang_priority.get(victim, 0)})")
+                quota_reason = self._quota_violation(members, req)
+        if quota_reason is not None:
+            result.unschedulable.extend(p.name for p in members)
+            self.metrics.inc("schedule_quota_denied")
+            self.trace.record("quota", gang=gang_name,
+                              detail={"reason": quota_reason})
+            log.warning("quota_denied", gang=gang_name,
+                        reason=quota_reason)
+            return
         # 0-device pods (CPU fallback, BASELINE config 1): bind to any
         # ready node, TPU-bearing or not.
         if req.total_chips == 0 and req.millitpu_per_pod == 0:
@@ -550,6 +615,65 @@ class DeviceScheduler:
             else:
                 chosen.remove(victim)
         return chosen
+
+    def _plan_quota_preemption(self, ns: str, req: GangRequest,
+                               priority: int) -> list[str] | None:
+        """Victims (strictly lower priority, SAME namespace) whose
+        eviction brings the namespace's usage plus ``req`` back under its
+        Quota.  Greedy lowest-priority-first, newest commit breaks ties;
+        stops as soon as the budget fits.  Returns None when no set
+        works (nobody is evicted)."""
+        from kubegpu_tpu.kubemeta import NotFound
+
+        try:
+            quota = self.api.get("Quota", "quota", namespace=ns)
+        except NotFound:
+            return None
+        idx = {g: i for i, g in enumerate(self._committed)}
+        order = sorted(
+            (g for g in self._committed
+             if self._gang_priority.get(g, 0) < priority),
+            key=lambda g: (self._gang_priority.get(g, 0), -idx[g]))
+        # per-gang usage, namespace-scoped (members carry the namespace)
+        need_c = req.total_chips
+        need_m = req.num_pods * req.millitpu_per_pod
+        used_c = used_m = 0
+        gang_usage: dict[str, tuple[int, int]] = {}
+        for pod in self.api.list("Pod", namespace=ns,
+                                 phase=(PodPhase.SCHEDULED,
+                                        PodPhase.RUNNING)):
+            alloc = pod_allocation(pod)
+            if alloc is None:
+                continue
+            gang = alloc.gang_name or pod.name
+            c = sum(1 for ch in alloc.chips if ch.millichips >= 1000)
+            m = sum(ch.millichips for ch in alloc.chips
+                    if ch.millichips < 1000)
+            used_c += c
+            used_m += m
+            gc, gm = gang_usage.get(gang, (0, 0))
+            gang_usage[gang] = (gc + c, gm + m)
+
+        def fits() -> bool:
+            if quota.spec.tpu_chips is not None \
+                    and used_c + need_c > quota.spec.tpu_chips:
+                return False
+            if quota.spec.millitpu is not None \
+                    and used_m + need_m > quota.spec.millitpu:
+                return False
+            return True
+
+        chosen: list[str] = []
+        for victim in order:
+            if fits():
+                break
+            vc, vm = gang_usage.get(victim, (0, 0))
+            if vc == 0 and vm == 0:
+                continue   # other-namespace gang; frees no quota here
+            used_c -= vc
+            used_m -= vm
+            chosen.append(victim)
+        return chosen if fits() and chosen else None
 
     def gang_member_pods(self, gang: str) -> list[Pod]:
         """LIVE members identified by their allocation's gang name

@@ -1,0 +1,130 @@
+"""Namespace device quotas (k8s ResourceQuota parity): the scheduler
+denies asks that would push a namespace's live usage past its Quota."""
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, PodPhase
+
+
+class TestQuota:
+    def test_quota_denies_over_budget_gang(self):
+        cl = SimCluster(["v5e-16"])
+        cl.set_quota("team-a", chips=4)
+        cl.submit(tpu_pod("a1", chips=4, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "a1" in result.scheduled
+        cl.submit(tpu_pod("a2", chips=1, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "a2" in result.unschedulable
+        snap = cl.metrics.snapshot()
+        assert snap["counters"]["schedule_quota_denied"] == 1.0
+        cl.close()
+
+    def test_quota_is_per_namespace(self):
+        cl = SimCluster(["v5e-16"])
+        cl.set_quota("team-a", chips=1)
+        # team-b has no quota: unlimited
+        cl.submit(tpu_pod("b1", chips=4, namespace="team-b",
+                          command=["x"]))
+        cl.submit(tpu_pod("a1", chips=4, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "b1" in result.scheduled
+        assert "a1" in result.unschedulable
+        cl.close()
+
+    def test_quota_frees_on_completion(self):
+        cl = SimCluster(["v4-8"])
+        cl.set_quota("team-a", chips=4)
+        cl.submit(tpu_pod("a1", chips=4, namespace="team-a",
+                          command=["x"]))
+        cl.step()
+        cl.submit(tpu_pod("a2", chips=2, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "a2" in result.unschedulable
+        cl.reap(timeout=0)   # a1 finishes → usage drops to 0
+        result, _ = cl.step()
+        assert "a2" in result.scheduled
+        cl.close()
+
+    def test_gang_counted_as_a_whole(self):
+        cl = SimCluster(["v5e-16"])
+        cl.set_quota("team-a", chips=4)
+        cl.submit(*[
+            tpu_pod(f"g-{i}", chips=2, namespace="team-a",
+                    gang=GangSpec(name="g", size=4, index=i),
+                    command=["x"])
+            for i in range(4)   # 8 chips total > 4 quota
+        ])
+        result, _ = cl.step()
+        assert len(result.unschedulable) == 4
+        for i in range(4):
+            pod = cl.api.get("Pod", f"g-{i}", namespace="team-a")
+            assert pod.status.phase == PodPhase.PENDING
+        cl.close()
+
+    def test_millitpu_quota(self):
+        cl = SimCluster(["v4-8"])
+        cl.set_quota("team-a", millitpu=500)
+        cl.submit(tpu_pod("f1", millitpu=400, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "f1" in result.scheduled
+        cl.submit(tpu_pod("f2", millitpu=400, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "f2" in result.unschedulable
+        cl.close()
+
+    def test_spec_file_quotas_section(self, tmp_path):
+        from kubegpu_tpu.cli import main
+        spec = tmp_path / "q.yaml"
+        spec.write_text(
+            "cluster: {slices: [v5e-16]}\n"
+            "quotas:\n"
+            "  team-a: {chips: 2}\n"
+            "pods:\n"
+            "  - {name: ok, chips: 2, namespace: team-a, command: [x]}\n"
+            "  - {name: over, chips: 2, namespace: team-a, command: [x]}\n")
+        # apply schedules 'ok', denies 'over' (still pending at the end)
+        rc = main(["apply", "-f", str(spec), "--schedule-only"])
+        assert rc == 0
+
+    def test_high_priority_preempts_same_namespace_for_quota(self):
+        """Review regression: a priority-10 gang at the namespace quota
+        ceiling must evict the tenant's own lower-priority gang rather
+        than sit unschedulable forever."""
+        cl = SimCluster(["v5e-16"])
+        cl.set_quota("team-a", chips=4)
+        cl.submit(tpu_pod("low", chips=4, namespace="team-a",
+                          command=["x"], priority=0))
+        result, _ = cl.step()
+        assert "low" in result.scheduled
+        cl.submit(tpu_pod("high", chips=4, namespace="team-a",
+                          command=["x"], priority=10))
+        result, _ = cl.step()
+        assert "high" in result.scheduled
+        low = cl.api.get("Pod", "low", namespace="team-a")
+        assert low.status.phase == PodPhase.PENDING   # requeued whole
+        cl.close()
+
+    def test_quota_preemption_never_crosses_namespaces(self):
+        """Quota pressure in team-a must not evict team-b's gangs (they
+        free no team-a budget)."""
+        cl = SimCluster(["v5e-16", "v5e-16"])
+        cl.set_quota("team-a", chips=4)
+        cl.submit(tpu_pod("b-low", chips=4, namespace="team-b",
+                          command=["x"], priority=0))
+        cl.submit(tpu_pod("a-1", chips=4, namespace="team-a",
+                          command=["x"], priority=0))
+        cl.step()
+        cl.submit(tpu_pod("a-hi", chips=4, namespace="team-a",
+                          command=["x"], priority=10))
+        result, _ = cl.step()
+        # a-hi preempts a-1 (same ns), b-low untouched
+        assert "a-hi" in result.scheduled
+        b = cl.api.get("Pod", "b-low", namespace="team-b")
+        assert b.status.phase != PodPhase.PENDING
+        cl.close()
