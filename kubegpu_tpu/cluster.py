@@ -133,7 +133,8 @@ class SimCluster:
         if ev.type == "DELETED" or (
                 ev.type == "MODIFIED"
                 and pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)):
-            self.scheduler.return_pod_resources(pod.name)
+            self.scheduler.return_pod_resources(pod.name,
+                                                pod.metadata.namespace)
 
     # -- driving ---------------------------------------------------------
 

@@ -21,6 +21,7 @@ from kubegpu_tpu.models.moe import (
     moe_init,
     moe_param_specs,
 )
+from kubegpu_tpu.models.quant import QTensor, quantize_llama
 from kubegpu_tpu.models.vit import (
     ViTConfig,
     vit_forward,
@@ -33,4 +34,5 @@ __all__ = [
     "MoEConfig", "moe_forward", "moe_init", "moe_param_specs",
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
+    "QTensor", "quantize_llama",
 ]

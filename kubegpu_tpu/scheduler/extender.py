@@ -101,6 +101,23 @@ class DeviceScheduler:
         self.sync()
 
     # ------------------------------------------------------------------
+    # Identity: in-memory gang/pod keys are NAMESPACE-QUALIFIED so two
+    # tenants may both run a gang called "train" (or a pod "worker-0")
+    # without colliding in the scheduler's registries.  The wire format
+    # (allocation annotations) keeps the bare gang name — namespace is
+    # already carried by the Pod object itself.
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _gkey(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    @staticmethod
+    def _split_gkey(key: str) -> tuple[str, str]:
+        ns, _, bare = key.partition("/")
+        return ns, bare
+
+    # ------------------------------------------------------------------
     # Cluster-state cache (annotation truth)
     # ------------------------------------------------------------------
 
@@ -130,8 +147,9 @@ class DeviceScheduler:
                 continue
             if alloc.slice_id in self.slices:
                 self.slices[alloc.slice_id].take(alloc.chips)
-            gang = alloc.gang_name or pod.name
-            self._pod_gang[pod.name] = gang
+            ns = pod.metadata.namespace
+            gang = self._gkey(ns, alloc.gang_name or pod.name)
+            self._pod_gang[self._gkey(ns, pod.name)] = gang
             self._gang_priority[gang] = max(
                 self._gang_priority.get(gang, pod.spec.priority),
                 pod.spec.priority)
@@ -246,10 +264,11 @@ class DeviceScheduler:
             if gspec is None:
                 units.append(("single", pod))
             else:
-                pg = gangs.get(gspec.name)
+                gkey = self._gkey(pod.metadata.namespace, gspec.name)
+                pg = gangs.get(gkey)
                 if pg is None:
-                    pg = gangs[gspec.name] = _PendingGang(spec=gspec)
-                    units.append(("gang", gspec.name))
+                    pg = gangs[gkey] = _PendingGang(spec=gspec)
+                    units.append(("gang", gkey))
                 pg.pods[gspec.index] = pod
         # forget incomplete-gang arrival times for gangs no longer pending
         self._gang_first_seen = {
@@ -315,20 +334,21 @@ class DeviceScheduler:
                 except ValueError as e:
                     self._reject(pod.name, [pod], str(e), result)
                     continue
-                self._schedule_gang(pod.name, [pod], req, result,
-                                    priority=pod.spec.priority,
-                                    precomputed=precomputed)
+                self._schedule_gang(
+                    self._gkey(pod.metadata.namespace, pod.name),
+                    [pod], req, result, priority=pod.spec.priority,
+                    precomputed=precomputed)
                 continue
-            gname = unit
-            pg = gangs[gname]
-            self._gang_first_seen.pop(gname, None)
+            gkey = unit
+            pg = gangs[gkey]
+            self._gang_first_seen.pop(gkey, None)
             members = [pg.pods[i] for i in range(pg.spec.size)]
             try:
-                req = self._request_for_gang(gname, members)
+                req = self._request_for_gang(pg.spec.name, members)
             except ValueError as e:
-                self._reject(gname, members, str(e), result)
+                self._reject(gkey, members, str(e), result)
                 continue
-            self._schedule_gang(gname, members, req, result,
+            self._schedule_gang(gkey, members, req, result,
                                 priority=pg.priority,
                                 precomputed=precomputed)
         return result
@@ -426,20 +446,7 @@ class DeviceScheduler:
             return None   # no quota object → unlimited
         ask_chips = req.total_chips
         ask_milli = req.num_pods * req.millitpu_per_pod
-        used_chips = used_milli = 0
-        # allocations only exist on bound/running pods — field-select
-        # before the apiserver's per-object clone
-        for pod in self.api.list("Pod", namespace=ns,
-                                 phase=(PodPhase.SCHEDULED,
-                                        PodPhase.RUNNING)):
-            alloc = pod_allocation(pod)
-            if alloc is None:
-                continue
-            for ch in alloc.chips:
-                if ch.millichips >= 1000:
-                    used_chips += 1
-                else:
-                    used_milli += ch.millichips
+        used_chips, used_milli, _ = self._namespace_usage(ns)
         limit_c = quota.spec.tpu_chips
         limit_m = quota.spec.millitpu
         if limit_c is not None and used_chips + ask_chips > limit_c:
@@ -450,10 +457,35 @@ class DeviceScheduler:
                     f"{ask_milli} requested > {limit_m}")
         return None
 
+    def _namespace_usage(self, ns: str) -> tuple[int, int, dict]:
+        """(used_chips, used_millitpu, per-gang {gkey: (chips, milli)})
+        over LIVE allocations in the namespace — annotation truth, shared
+        by the quota gate and the quota-preemption planner.  Allocations
+        only exist on bound/running pods, so the field selectors keep the
+        apiserver from cloning the whole cluster."""
+        used_c = used_m = 0
+        per_gang: dict[str, tuple[int, int]] = {}
+        for pod in self.api.list("Pod", namespace=ns,
+                                 phase=(PodPhase.SCHEDULED,
+                                        PodPhase.RUNNING)):
+            alloc = pod_allocation(pod)
+            if alloc is None:
+                continue
+            gkey = self._gkey(ns, alloc.gang_name or pod.name)
+            c = sum(1 for ch in alloc.chips if ch.millichips >= 1000)
+            m = sum(ch.millichips for ch in alloc.chips
+                    if ch.millichips < 1000)
+            used_c += c
+            used_m += m
+            gc, gm = per_gang.get(gkey, (0, 0))
+            per_gang[gkey] = (gc + c, gm + m)
+        return used_c, used_m, per_gang
+
     def _schedule_gang(self, gang_name: str, members: list[Pod],
                        req: GangRequest, result: ScheduleResult,
                        priority: int = 0,
                        precomputed: GangAssignment | None = None) -> None:
+        """``gang_name`` is the namespace-qualified gang key."""
         t0 = time.perf_counter()
         quota_reason = self._quota_violation(members, req)
         if quota_reason is not None \
@@ -526,9 +558,11 @@ class DeviceScheduler:
         self.allocator.commit(self.slices, asg)
         self._committed[gang_name] = asg
         self._gang_priority[gang_name] = priority
+        bare_gang = self._split_gkey(gang_name)[1]
         for pod, alloc in zip(members, allocations):
-            alloc.gang_name = gang_name
-            self._pod_gang[pod.name] = gang_name
+            alloc.gang_name = bare_gang   # wire format: bare name
+            self._pod_gang[self._gkey(pod.metadata.namespace,
+                                      pod.name)] = gang_name
             self.api.patch_annotations(
                 "Pod", pod.name,
                 {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc)},
@@ -556,8 +590,9 @@ class DeviceScheduler:
     # Pod lifecycle: return resources on completion/deletion (§4.4)
     # ------------------------------------------------------------------
 
-    def return_pod_resources(self, pod_name: str) -> None:
-        gang = self._pod_gang.pop(pod_name, None)
+    def return_pod_resources(self, pod_name: str,
+                             namespace: str = "default") -> None:
+        gang = self._pod_gang.pop(self._gkey(namespace, pod_name), None)
         if gang is None:
             return
         # release only when the last member of the gang is gone
@@ -618,11 +653,16 @@ class DeviceScheduler:
 
     def _plan_quota_preemption(self, ns: str, req: GangRequest,
                                priority: int) -> list[str] | None:
-        """Victims (strictly lower priority, SAME namespace) whose
-        eviction brings the namespace's usage plus ``req`` back under its
-        Quota.  Greedy lowest-priority-first, newest commit breaks ties;
-        stops as soon as the budget fits.  Returns None when no set
-        works (nobody is evicted)."""
+        """Victims (strictly lower priority, SAME namespace — per-gang
+        usage is namespace-scoped) whose eviction brings the namespace's
+        usage plus ``req`` back under its Quota.  Greedy
+        lowest-priority-first with newest-commit tie-break, then a
+        minimization pass re-admits victims the budget doesn't need, then
+        a PLACEMENT feasibility trial on cloned slice states (the evicted
+        chips must actually let ``req`` place, counting a follow-up
+        capacity preemption) — no eviction set is returned unless the
+        whole plan succeeds, so quota pressure never thrash-kills gangs
+        it cannot benefit from."""
         from kubegpu_tpu.kubemeta import NotFound
 
         try:
@@ -634,38 +674,22 @@ class DeviceScheduler:
             (g for g in self._committed
              if self._gang_priority.get(g, 0) < priority),
             key=lambda g: (self._gang_priority.get(g, 0), -idx[g]))
-        # per-gang usage, namespace-scoped (members carry the namespace)
         need_c = req.total_chips
         need_m = req.num_pods * req.millitpu_per_pod
-        used_c = used_m = 0
-        gang_usage: dict[str, tuple[int, int]] = {}
-        for pod in self.api.list("Pod", namespace=ns,
-                                 phase=(PodPhase.SCHEDULED,
-                                        PodPhase.RUNNING)):
-            alloc = pod_allocation(pod)
-            if alloc is None:
-                continue
-            gang = alloc.gang_name or pod.name
-            c = sum(1 for ch in alloc.chips if ch.millichips >= 1000)
-            m = sum(ch.millichips for ch in alloc.chips
-                    if ch.millichips < 1000)
-            used_c += c
-            used_m += m
-            gc, gm = gang_usage.get(gang, (0, 0))
-            gang_usage[gang] = (gc + c, gm + m)
+        used_c, used_m, gang_usage = self._namespace_usage(ns)
 
-        def fits() -> bool:
+        def fits(c: int, m: int) -> bool:
             if quota.spec.tpu_chips is not None \
-                    and used_c + need_c > quota.spec.tpu_chips:
+                    and c + need_c > quota.spec.tpu_chips:
                 return False
             if quota.spec.millitpu is not None \
-                    and used_m + need_m > quota.spec.millitpu:
+                    and m + need_m > quota.spec.millitpu:
                 return False
             return True
 
         chosen: list[str] = []
         for victim in order:
-            if fits():
+            if fits(used_c, used_m):
                 break
             vc, vm = gang_usage.get(victim, (0, 0))
             if vc == 0 and vm == 0:
@@ -673,21 +697,56 @@ class DeviceScheduler:
             used_c -= vc
             used_m -= vm
             chosen.append(victim)
-        return chosen if fits() and chosen else None
+        if not (fits(used_c, used_m) and chosen):
+            return None
+        # minimize: re-admit victims the budget doesn't actually need
+        for victim in list(chosen):
+            vc, vm = gang_usage.get(victim, (0, 0))
+            if fits(used_c + vc, used_m + vm):
+                used_c += vc
+                used_m += vm
+                chosen.remove(victim)
+        # placement feasibility: with the victims' chips freed (plus any
+        # follow-up capacity preemption of remaining lower-priority
+        # gangs), must req actually place?  Otherwise evicting buys
+        # nothing and the victims would thrash.
+        trial = {sid: st.clone() for sid, st in self.slices.items()}
+        for victim in chosen:
+            asg = self._committed[victim]
+            self.allocator.rollback(trial, asg)
+        if self.allocator.find_assignment(
+                list(trial.values()), req) is None:
+            placed = False
+            for victim in order:
+                if victim in chosen:
+                    continue
+                asg = self._committed[victim]
+                if not any(sid in trial for sid in asg.slice_ids):
+                    continue
+                self.allocator.rollback(trial, asg)
+                if self.allocator.find_assignment(
+                        list(trial.values()), req) is not None:
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return chosen
 
     def gang_member_pods(self, gang: str) -> list[Pod]:
-        """LIVE members identified by their allocation's gang name
-        (annotation truth) — never by bare pod name, which can collide
-        across namespaces.  Terminal pods are excluded: a completed member
-        keeps its allocation annotation, and evicting it would silently
-        resurrect and re-run a finished workload."""
+        """LIVE members of a namespace-qualified gang key, identified by
+        namespace + their allocation's gang name (annotation truth).
+        Terminal pods are excluded: a completed member keeps its
+        allocation annotation, and evicting it would silently resurrect
+        and re-run a finished workload."""
         from kubegpu_tpu.kubemeta import pod_allocation
+
+        ns, bare = self._split_gkey(gang)
         out = []
-        for p in self.api.list("Pod"):
+        for p in self.api.list("Pod", namespace=ns):
             if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
                 continue
             alloc = pod_allocation(p)
-            if alloc is not None and (alloc.gang_name or p.name) == gang:
+            if alloc is not None and (alloc.gang_name or p.name) == bare:
                 out.append(p)
         return out
 
@@ -714,7 +773,7 @@ class DeviceScheduler:
             # Belt-and-braces: free chips even when no lifecycle wiring
             # (e.g. scheduler used standalone in tests) — idempotent, the
             # first call pops the pod from the gang map.
-            self.return_pod_resources(pod.name)
+            self.return_pod_resources(pod.name, pod.metadata.namespace)
         requeued: list[str] = []
         for pod in pods:
             annotations = {k: v for k, v in pod.metadata.annotations.items()

@@ -57,7 +57,7 @@ class TestStructuredLogging:
         assert ("scheduler", "schedule") in kinds
         assert ("crishim", "create_container") in kinds
         sched = next(e for e in events if e["event"] == "schedule")
-        assert sched["gang"] == "p" and sched["pods"] == 1
+        assert sched["gang"] == "default/p" and sched["pods"] == 1
 
     def test_silent_by_default(self, capsys):
         """No handler configured → nothing reaches stderr and nothing

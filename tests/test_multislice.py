@@ -124,7 +124,7 @@ class TestMultisliceCluster:
         used = sum(sum(st.used_millichips.values())
                    for st in fresh.slices.values())
         assert used == 8000
-        asg = fresh._committed["ms"]
+        asg = fresh._committed["default/ms"]
         assert len(asg.slice_ids) == 2
         cl.close()
 
@@ -137,7 +137,7 @@ class TestMultisliceCluster:
         victim_alloc = pod_allocation(cl.api.get("Pod", "ms-0"))
         cl.fail_host(victim_alloc.node_name)
         rec = cl.recovery.run_once()
-        assert "ms" in rec.evicted_gangs
+        assert "default/ms" in rec.evicted_gangs
         for i in range(4):
             assert cl.pod_phase(f"ms-{i}") == PodPhase.PENDING
         cl.close()
@@ -190,6 +190,6 @@ class TestMultisliceFaultPrecedence:
         # hard fault: the other slice's host dies
         cl.fail_host(a2.node_name)
         rec = cl.recovery.run_once()
-        assert "ms" in rec.evicted_gangs, rec
-        assert "ms" not in cl.recovery._degraded
+        assert "default/ms" in rec.evicted_gangs, rec
+        assert "default/ms" not in cl.recovery._degraded
         cl.close()

@@ -128,3 +128,36 @@ class TestQuota:
         b = cl.api.get("Pod", "b-low", namespace="team-b")
         assert b.status.phase != PodPhase.PENDING
         cl.close()
+
+    def test_same_gang_name_across_namespaces_not_conflated(self):
+        """Review regression: two tenants both running a gang named
+        'train' must have independent scheduler identities — quota
+        preemption in one namespace must never evict the other's."""
+        cl = SimCluster(["v5e-16", "v5e-16"])
+        cl.set_quota("team-a", chips=8)
+        for ns in ("team-a", "team-b"):
+            cl.submit(*[
+                tpu_pod(f"train-{i}", chips=4, namespace=ns,
+                        gang=GangSpec(name="train", size=2, index=i),
+                        command=["x"], priority=0)
+                for i in range(2)
+            ])
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4
+        assert set(cl.scheduler._committed) == {"team-a/train",
+                                                "team-b/train"}
+        # quota pressure in team-a evicts team-a/train only
+        cl.submit(*[
+            tpu_pod(f"hi-{i}", chips=4, namespace="team-a",
+                    gang=GangSpec(name="hi", size=2, index=i),
+                    command=["x"], priority=9)
+            for i in range(2)
+        ])
+        result, _ = cl.step()
+        assert set(result.scheduled) == {"hi-0", "hi-1"}
+        for i in range(2):
+            a = cl.api.get("Pod", f"train-{i}", namespace="team-a")
+            b = cl.api.get("Pod", f"train-{i}", namespace="team-b")
+            assert a.status.phase == PodPhase.PENDING
+            assert b.status.phase != PodPhase.PENDING
+        cl.close()
