@@ -25,7 +25,10 @@ import jax.numpy as jnp
 @jax.tree_util.register_pytree_node_class
 class QTensor:
     """Symmetric per-output-channel int8 weight: ``values`` [..., out]
-    int8, ``scale`` [out] f32 such that ``w ≈ values * scale``."""
+    int8, ``scale`` f32 broadcastable against ``values`` (reduced axes
+    kept as size-1, so stacked [L, 1, out] scales slice in lockstep with
+    [L, in, out] values under ``lax.scan``) such that
+    ``w ≈ values * scale``."""
 
     def __init__(self, values: jax.Array, scale: jax.Array):
         self.values = values
@@ -49,9 +52,12 @@ class QTensor:
 
     def __rmatmul__(self, x: jax.Array) -> jax.Array:
         # (x @ int8-as-activation-dtype) * scale: the cast and scale fuse
-        # into the matmul; weight traffic from HBM stays int8
-        return (x @ self.values.astype(x.dtype)) \
-            * self.scale.astype(x.dtype)
+        # into the matmul; weight traffic from HBM stays int8.  The
+        # contracted (second-to-last) axis drops out of the product, so
+        # drop its size-1 slot from the kept-dims scale too.
+        scale = jnp.squeeze(self.scale.astype(x.dtype), axis=-2) \
+            if self.scale.ndim >= 2 else self.scale.astype(x.dtype)
+        return (x @ self.values.astype(x.dtype)) * scale
 
     def __matmul__(self, other):  # pragma: no cover - weights are RHS
         return self.dequantize() @ other
@@ -74,11 +80,9 @@ def quantize(w: jax.Array, batch_dims: int = 0) -> QTensor:
     slice values and scale together."""
     wf = w.astype(jnp.float32)
     reduce_axes = tuple(range(batch_dims, w.ndim - 1))
-    amax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=False)
+    amax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    # broadcastable view of scale against w for the division
-    full = jnp.expand_dims(scale, tuple(range(batch_dims, w.ndim - 1)))
-    q = jnp.clip(jnp.round(wf / full), -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
     return QTensor(q, scale)
 
 

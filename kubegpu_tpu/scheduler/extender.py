@@ -590,8 +590,10 @@ class DeviceScheduler:
     # Pod lifecycle: return resources on completion/deletion (§4.4)
     # ------------------------------------------------------------------
 
-    def return_pod_resources(self, pod_name: str,
-                             namespace: str = "default") -> None:
+    def return_pod_resources(self, pod_name: str, namespace: str) -> None:
+        """Namespace is REQUIRED: pod identity is namespace-qualified,
+        and a defaulted wrong namespace would silently no-op and leak the
+        gang's chips until the next full sync."""
         gang = self._pod_gang.pop(self._gkey(namespace, pod_name), None)
         if gang is None:
             return

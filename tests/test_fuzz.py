@@ -68,6 +68,7 @@ def check_sync_convergence(cl) -> None:
 def test_control_plane_fuzz(seed):
     rng = random.Random(seed)
     cl = SimCluster(["v5e-16", "v4-8", "v4-8"])
+    cl.set_quota("team-a", chips=10)   # one bounded tenant in the mix
     counter = 0
     hosts = [a.node_name for a in cl.agents]
     down_hosts: set = set()
@@ -78,21 +79,25 @@ def test_control_plane_fuzz(seed):
         counter += 1
         kind = rng.random()
         prio = rng.choice([0, 0, 0, 5, 10])
+        ns = rng.choice(["default", "default", "team-a", "team-b"])
         if kind < 0.15:
             cl.submit(tpu_pod(f"f{counter}", millitpu=rng.choice([300, 500]),
-                              command=["x"], priority=prio))
+                              command=["x"], priority=prio, namespace=ns))
         elif kind < 0.4:
             cl.submit(tpu_pod(f"s{counter}", chips=rng.choice([1, 2, 4]),
-                              command=["x"], priority=prio))
+                              command=["x"], priority=prio, namespace=ns))
         else:
             size = rng.choice([2, 4, 8])
             chips = rng.choice([1, 2])
             ms = rng.random() < 0.5
+            # same-name gangs across namespaces on purpose (identity keys)
+            gname = rng.choice([f"g{counter}", "shared"])
             pods = [tpu_pod(f"g{counter}-{k}", chips=chips,
-                            gang=GangSpec(name=f"g{counter}", size=size,
+                            gang=GangSpec(name=gname, size=size,
                                           index=k),
                             mesh_axes={"dp": size, "tp": chips},
-                            multislice=ms, command=["x"], priority=prio)
+                            multislice=ms, command=["x"], priority=prio,
+                            namespace=ns)
                     for k in range(size)]
             if rng.random() < 0.25:
                 pods = pods[:-1]   # trickle: last member arrives later (or

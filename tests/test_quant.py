@@ -82,3 +82,18 @@ class TestQuantizedLlama:
         # int8 weights perturb logits; most greedy picks still agree
         agree = float((np.asarray(toks_q) == np.asarray(toks_f)).mean())
         assert agree >= 0.5, (toks_q, toks_f)
+
+
+class TestStackedQTensor:
+    def test_stacked_dequantize_broadcasts(self, tiny):
+        """Review regression: layers leaves ([L, in, out] values with
+        [L, 1, out] scales) must dequantize correctly outside lax.scan
+        (export/debug paths), not crash or silently mis-scale."""
+        cfg, params = tiny
+        from kubegpu_tpu.models.quant import quantize_llama
+        q = quantize_llama(params)["layers"]["wq"]
+        d = q.dequantize()
+        assert d.shape == params["layers"]["wq"].shape
+        err = jnp.max(jnp.abs(d - params["layers"]["wq"])
+                      / jnp.squeeze(q.scale, -2)[:, None, :])
+        assert float(err) <= 0.5 + 1e-6

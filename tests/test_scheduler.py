@@ -122,12 +122,12 @@ class TestResilience:
         used = sum(sum(st.used_millichips.values())
                    for st in fresh.slices.values())
         assert used == 8000
-        fresh.return_pod_resources("g-0")
+        fresh.return_pod_resources("g-0", "default")
         # gang partially alive → not yet released
         used = sum(sum(st.used_millichips.values())
                    for st in fresh.slices.values())
         assert used == 8000
-        fresh.return_pod_resources("g-1")
+        fresh.return_pod_resources("g-1", "default")
         used = sum(sum(st.used_millichips.values())
                    for st in fresh.slices.values())
         assert used == 0
