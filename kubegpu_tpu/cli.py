@@ -75,11 +75,12 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
         env = {str(k): str(v) for k, v in (entry.get("env") or {}).items()}
         priority = int(entry.get("priority", 0))
         multislice = bool(entry.get("multislice", False))
+        migratable = bool(entry.get("migratable", False))
         if gang is None:
             pods.append(tpu_pod(name, chips=chips, millitpu=millitpu,
                                 mesh_axes=axes, command=command, env=env,
                                 priority=priority, multislice=multislice,
-                                namespace=namespace))
+                                namespace=namespace, migratable=migratable))
             continue
         if isinstance(gang, int):
             gang = {"size": gang}
@@ -91,7 +92,7 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
                 gang=GangSpec(name=gname, size=size, index=i),
                 mesh_axes=axes, command=command, env=env,
                 priority=priority, multislice=multislice,
-                namespace=namespace))
+                namespace=namespace, migratable=migratable))
     return pods, slices
 
 

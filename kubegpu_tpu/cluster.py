@@ -26,6 +26,7 @@ from kubegpu_tpu.kubemeta import (
 from kubegpu_tpu.kubemeta.codec import (
     set_pod_gang,
     set_pod_mesh_axes,
+    set_pod_migratable,
     set_pod_multislice,
 )
 from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace
@@ -49,7 +50,8 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
             env: dict[str, str] | None = None,
             priority: int = 0,
             multislice: bool = False,
-            namespace: str = "default") -> Pod:
+            namespace: str = "default",
+            migratable: bool = False) -> Pod:
     """Pod-spec builder — the user surface (reference: example/ YAML)."""
     pod = Pod(
         metadata=ObjectMeta(name=name, namespace=namespace),
@@ -66,6 +68,8 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
         set_pod_mesh_axes(pod, mesh_axes)
     if multislice:
         set_pod_multislice(pod)
+    if migratable:
+        set_pod_migratable(pod)
     return pod
 
 

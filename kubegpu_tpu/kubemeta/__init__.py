@@ -38,10 +38,12 @@ from kubegpu_tpu.kubemeta.codec import (
     pod_allocation,
     pod_gang_spec,
     pod_mesh_axes,
+    pod_migratable,
     pod_multislice,
     set_pod_allocation,
     set_pod_gang,
     set_pod_mesh_axes,
+    set_pod_migratable,
     set_pod_multislice,
 )
 from kubegpu_tpu.kubemeta.controlplane import (
@@ -59,7 +61,8 @@ __all__ = [
     "allocation_from_annotation", "allocation_to_annotation",
     "node_advertisement", "node_advertisement_from_annotation",
     "node_advertisement_to_annotation", "pod_allocation", "pod_gang_spec",
-    "pod_mesh_axes", "pod_multislice", "set_pod_allocation",
+    "pod_mesh_axes", "pod_migratable", "pod_multislice",
+    "set_pod_allocation", "set_pod_migratable",
     "set_pod_gang", "set_pod_mesh_axes", "set_pod_multislice",
     "Conflict", "FakeApiServer", "NotFound", "WatchEvent",
 ]

@@ -24,6 +24,12 @@ ALLOCATE_FROM_KEY = "pod.alpha.kubetpu/allocate-from"
 GANG_KEY = "pod.alpha.kubetpu/gang"
 MESH_AXES_KEY = "pod.alpha.kubetpu/mesh-axes"
 MULTISLICE_KEY = "pod.alpha.kubetpu/multislice"
+MIGRATABLE_KEY = "pod.alpha.kubetpu/migratable"
+# original queue position of an evicted+requeued pod: eviction (fault,
+# preemption, migration) must not cost a gang its FIFO seniority, or any
+# equal-priority pending unit could steal the home a migration plan
+# proved for it
+QUEUED_AT_KEY = "pod.alpha.kubetpu/queued-at"
 
 
 # ---------------------------------------------------------------------------
@@ -195,6 +201,20 @@ def pod_mesh_axes(pod: Pod) -> dict[str, int] | None:
     if not payload:
         return None
     return dict((k, int(v)) for k, v in json.loads(payload))
+
+
+def set_pod_migratable(pod: Pod, allowed: bool = True) -> None:
+    """Mark the pod's gang as migratable: the scheduler may evict and
+    requeue it (checkpoint/resume semantics, like fault recovery) to
+    defragment space for an otherwise-unplaceable gang."""
+    if allowed:
+        pod.metadata.annotations[MIGRATABLE_KEY] = "true"
+    else:
+        pod.metadata.annotations.pop(MIGRATABLE_KEY, None)
+
+
+def pod_migratable(pod: Pod) -> bool:
+    return pod.metadata.annotations.get(MIGRATABLE_KEY) == "true"
 
 
 def set_pod_multislice(pod: Pod, allowed: bool = True) -> None:

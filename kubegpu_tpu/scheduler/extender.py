@@ -42,6 +42,7 @@ from kubegpu_tpu.kubemeta import (
     pod_allocation,
     pod_gang_spec,
     pod_mesh_axes,
+    pod_migratable,
     pod_multislice,
 )
 from kubegpu_tpu.kubemeta.codec import (
@@ -97,7 +98,12 @@ class DeviceScheduler:
         self._committed: dict[str, GangAssignment] = {}  # gang → assignment
         self._pod_gang: dict[str, str] = {}              # pod name → gang
         self._gang_priority: dict[str, int] = {}         # committed gangs
+        self._gang_migratable: dict[str, bool] = {}      # committed gangs
         self._gang_first_seen: dict[str, float] = {}     # incomplete gangs
+        # migration debts: a migrated gang's re-ask stays protected (same
+        # what-if machinery as the barrier) until it re-places, so no
+        # other unit — same pass or later — can take its proven home
+        self._migration_debts: dict[str, GangRequest] = {}
         self.sync()
 
     # ------------------------------------------------------------------
@@ -116,6 +122,13 @@ class DeviceScheduler:
     def _split_gkey(key: str) -> tuple[str, str]:
         ns, _, bare = key.partition("/")
         return ns, bare
+
+    @staticmethod
+    def _arrival(pod: Pod) -> int:
+        """Queue position: the original arrival for requeued pods."""
+        from kubegpu_tpu.kubemeta.codec import QUEUED_AT_KEY
+        stamped = pod.metadata.annotations.get(QUEUED_AT_KEY)
+        return int(stamped) if stamped else pod.metadata.resource_version
 
     # ------------------------------------------------------------------
     # Cluster-state cache (annotation truth)
@@ -138,6 +151,7 @@ class DeviceScheduler:
         self._committed.clear()
         self._pod_gang.clear()
         self._gang_priority.clear()
+        self._gang_migratable.clear()
         gang_pods: dict[str, list] = {}
         for pod in self.api.list("Pod"):
             if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
@@ -153,6 +167,9 @@ class DeviceScheduler:
             self._gang_priority[gang] = max(
                 self._gang_priority.get(gang, pod.spec.priority),
                 pod.spec.priority)
+            self._gang_migratable[gang] = (
+                self._gang_migratable.get(gang, True)
+                and pod_migratable(pod))
             gang_pods.setdefault(gang, []).append(alloc)
         # Rebuild committed assignments from annotation truth so later
         # completions release chips even across scheduler restarts/re-syncs.
@@ -256,7 +273,11 @@ class DeviceScheduler:
         now = time.monotonic()
         pending = [p for p in self.api.list("Pod", phase=PodPhase.PENDING)
                    if p.spec.node_name is None]
-        pending.sort(key=lambda p: p.metadata.resource_version)  # FIFO
+        # FIFO by ORIGINAL arrival: an evicted+requeued pod carries its
+        # first queue position (QUEUED_AT_KEY), so eviction never costs a
+        # gang its seniority — without this, an equal-priority pending
+        # unit could take the home a migration plan proved for a mover
+        pending.sort(key=self._arrival)
         gangs: dict[str, _PendingGang] = {}
         units: list[tuple[str, object]] = []  # FIFO by first member
         for pod in pending:
@@ -285,8 +306,17 @@ class DeviceScheduler:
             return (unit.spec.priority if kind == "single"
                     else gangs[unit].priority)
 
+        def unit_key(kind: str, unit) -> str:
+            return (self._gkey(unit.metadata.namespace, unit.name)
+                    if kind == "single" else unit)
+
         # stable sort: priority desc, FIFO within equal priority
         units.sort(key=lambda ku: -unit_priority(*ku))
+
+        # drop debts whose gang is gone entirely (user deleted the pods)
+        present = {unit_key(k, u) for k, u in units}
+        self._migration_debts = {
+            g: r for g, r in self._migration_debts.items() if g in present}
 
         barrier: str | None = None  # incomplete gang blocking later units
         protected: list[GangRequest] = []  # held units' asks, queue order
@@ -309,9 +339,14 @@ class DeviceScheduler:
                         protected.append(preq)
                 continue
             precomputed = None
-            if barrier is not None:
+            ukey = unit_key(kind, unit)
+            # a debtor may take its own reserved home; everyone else must
+            # prove the debts still fit after their placement
+            debts = [r for g, r in self._migration_debts.items()
+                     if g != ukey]
+            if barrier is not None or debts:
                 allowed, ureq, precomputed = self._may_backfill(
-                    kind, unit, gangs, protected)
+                    kind, unit, gangs, protected + debts)
                 if not allowed:
                     names = ([unit.name] if kind == "single" else
                              [p.name for p in gangs[unit].pods.values()])
@@ -322,11 +357,14 @@ class DeviceScheduler:
                         protected.append(ureq)
                     self.trace.record("defer", gang=unit if kind == "gang"
                                       else unit.name,
-                                      detail={"behind": barrier})
+                                      detail={"behind": barrier
+                                              or "migration-debt"})
                     continue
-                self.trace.record("backfill", gang=unit if kind == "gang"
-                                  else unit.name,
-                                  detail={"past": barrier})
+                if barrier is not None:
+                    self.trace.record("backfill",
+                                      gang=unit if kind == "gang"
+                                      else unit.name,
+                                      detail={"past": barrier})
             if kind == "single":
                 pod = unit
                 try:
@@ -544,6 +582,25 @@ class DeviceScheduler:
                         f"{self._gang_priority.get(victim, 0)})")
                 asg = self.allocator.find_assignment(
                     list(self.slices.values()), req)
+        if asg is None and any(self._gang_migratable.values()):
+            # defragmentation: migrate MIGRATABLE gangs (checkpointed
+            # workloads that tolerate a restart) to compact space — only
+            # under a joint plan proving the requester fits AND every
+            # migrated gang re-places afterwards
+            movers = self._plan_migration(req, priority)
+            if movers:
+                for victim in movers:
+                    # record the mover's re-ask as a debt BEFORE evicting
+                    # (the request needs the still-committed assignment)
+                    vreq = self._request_for_committed(victim)
+                    self.metrics.inc("gangs_migrated")
+                    self.evict_gang(
+                        victim,
+                        f"migrated to defragment for {gang_name}")
+                    if vreq is not None:
+                        self._migration_debts[victim] = vreq
+                asg = self.allocator.find_assignment(
+                    list(self.slices.values()), req)
         if asg is None:
             result.unschedulable.extend(p.name for p in members)
             self.metrics.inc("schedule_unschedulable")
@@ -558,6 +615,9 @@ class DeviceScheduler:
         self.allocator.commit(self.slices, asg)
         self._committed[gang_name] = asg
         self._gang_priority[gang_name] = priority
+        self._gang_migratable[gang_name] = all(
+            pod_migratable(p) for p in members)
+        self._migration_debts.pop(gang_name, None)   # debt repaid
         bare_gang = self._split_gkey(gang_name)[1]
         for pod, alloc in zip(members, allocations):
             alloc.gang_name = bare_gang   # wire format: bare name
@@ -601,6 +661,7 @@ class DeviceScheduler:
         if any(g == gang for g in self._pod_gang.values()):
             return
         self._gang_priority.pop(gang, None)
+        self._gang_migratable.pop(gang, None)
         asg = self._committed.pop(gang, None)
         if asg is not None:
             # rollback skips slices that vanished (multislice: free the rest)
@@ -612,19 +673,14 @@ class DeviceScheduler:
     # Preemption + eviction (shared with the fault-recovery controller)
     # ------------------------------------------------------------------
 
-    def _plan_preemption(self, req: GangRequest,
-                         priority: int) -> list[str] | None:
-        """Pick victim gangs (strictly lower priority) whose eviction lets
-        ``req`` fit — planned entirely on cloned slice states.  Greedy:
-        evict lowest-priority first (newest commit breaks ties, k8s-style
-        'youngest victim'), then a minimization pass re-admits any victim
-        the fit doesn't actually need.  Returns None when no eviction set
+    def _greedy_evict_plan(self, order: list[str], req: GangRequest
+                           ) -> tuple[list[str], dict] | None:
+        """Shared planner skeleton (capacity preemption AND migration):
+        on cloned slice states, roll victims back in ``order`` until
+        ``req`` places, then a minimization pass re-admits any victim the
+        fit doesn't actually need.  Returns (chosen victims, trial state
+        with survivors committed and victims freed), or None when no set
         works (then nobody is evicted — no pointless thrash)."""
-        idx = {g: i for i, g in enumerate(self._committed)}
-        order = sorted(
-            (g for g in self._committed
-             if self._gang_priority.get(g, 0) < priority),
-            key=lambda g: (self._gang_priority.get(g, 0), -idx[g]))
         if not order:
             return None
         trial = {sid: st.clone() for sid, st in self.slices.items()}
@@ -651,7 +707,20 @@ class DeviceScheduler:
                 self.allocator.rollback(trial, asg)   # still required
             else:
                 chosen.remove(victim)
-        return chosen
+        return chosen, trial
+
+    def _plan_preemption(self, req: GangRequest,
+                         priority: int) -> list[str] | None:
+        """Victim gangs (strictly lower priority) whose eviction lets
+        ``req`` fit.  Greedy lowest-priority first (newest commit breaks
+        ties, k8s-style 'youngest victim'), minimized."""
+        idx = {g: i for i, g in enumerate(self._committed)}
+        order = sorted(
+            (g for g in self._committed
+             if self._gang_priority.get(g, 0) < priority),
+            key=lambda g: (self._gang_priority.get(g, 0), -idx[g]))
+        plan = self._greedy_evict_plan(order, req)
+        return plan[0] if plan else None
 
     def _plan_quota_preemption(self, ns: str, req: GangRequest,
                                priority: int) -> list[str] | None:
@@ -734,6 +803,74 @@ class DeviceScheduler:
                 return None
         return chosen
 
+    def _request_for_committed(self, gang: str) -> GangRequest | None:
+        """Rebuild a committed gang's request from its assignment +
+        member annotations (the shape a migrated gang will re-ask for)."""
+        asg = self._committed.get(gang)
+        if asg is None or not asg.pods or not asg.pods[0].chips:
+            return None
+        chips_per_pod = len(asg.pods[0].chips)
+        if asg.pods[0].chips[0].millichips < 1000:
+            return None   # fractional singles aren't worth migrating
+        members = self.gang_member_pods(gang)
+        axes = pod_mesh_axes(members[0]) if members else None
+        try:
+            return GangRequest(
+                gang_name=gang, num_pods=len(asg.pods),
+                chips_per_pod=chips_per_pod,
+                mesh_axes=self._sane_axes(
+                    axes, len(asg.pods) * chips_per_pod),
+                allow_multislice=bool(members)
+                and pod_multislice(members[0]))
+        except ValueError:
+            return None
+
+    def _plan_migration(self, req: GangRequest,
+                        priority: int) -> list[str] | None:
+        """Defragmentation plan: the FEWEST MIGRATABLE committed gangs
+        (priority <= requester — migration must never disturb more
+        important work) whose eviction lets ``req`` place, under a JOINT
+        feasibility trial: after placing ``req`` on the cloned state,
+        every migrated gang's own request must re-place too (it was
+        running; the plan must leave it a home, not strand it pending —
+        and queue seniority preservation in evict_gang keeps pending
+        units from stealing that home).  Returns None unless the whole
+        plan closes."""
+        idx = {g: i for i, g in enumerate(self._committed)}
+        # largest-footprint first: each eviction frees the most space, so
+        # the greedy loop disturbs the fewest gangs (minimization prunes
+        # any leftovers); victims' re-ask requests are built LAZILY only
+        # for the chosen few (each build lists the namespace's pods)
+        order = sorted(
+            (g for g in self._committed
+             if self._gang_migratable.get(g, False)
+             and self._gang_priority.get(g, 0) <= priority
+             and self._committed[g].pods
+             and self._committed[g].pods[0].chips
+             and self._committed[g].pods[0].chips[0].millichips >= 1000),
+            key=lambda g: (-sum(len(p.chips)
+                                for p in self._committed[g].pods),
+                           self._gang_priority.get(g, 0), -idx[g]))
+        plan = self._greedy_evict_plan(order, req)
+        if plan is None:
+            return None
+        chosen, trial = plan
+        # joint closure: place req, then every mover must re-place
+        req_asg = self.allocator.find_assignment(list(trial.values()), req)
+        if req_asg is None:
+            return None
+        self.allocator.commit(trial, req_asg)
+        for victim in chosen:
+            vreq = self._request_for_committed(victim)
+            if vreq is None:
+                return None   # re-ask can't be rebuilt → no guarantee
+            v_asg = self.allocator.find_assignment(
+                list(trial.values()), vreq)
+            if v_asg is None:
+                return None   # would strand the migrated gang
+            self.allocator.commit(trial, v_asg)
+        return chosen
+
     def gang_member_pods(self, gang: str) -> list[Pod]:
         """LIVE members of a namespace-qualified gang key, identified by
         namespace + their allocation's gang name (annotation truth).
@@ -776,10 +913,15 @@ class DeviceScheduler:
             # (e.g. scheduler used standalone in tests) — idempotent, the
             # first call pops the pod from the gang map.
             self.return_pod_resources(pod.name, pod.metadata.namespace)
+        from kubegpu_tpu.kubemeta.codec import QUEUED_AT_KEY
+
         requeued: list[str] = []
         for pod in pods:
             annotations = {k: v for k, v in pod.metadata.annotations.items()
                            if k != ALLOCATE_FROM_KEY}
+            # preserve queue seniority across (repeated) evictions
+            annotations.setdefault(QUEUED_AT_KEY,
+                                   str(self._arrival(pod)))
             fresh = Pod(
                 metadata=ObjectMeta(
                     name=pod.metadata.name,

@@ -97,7 +97,8 @@ def test_control_plane_fuzz(seed):
                                           index=k),
                             mesh_axes={"dp": size, "tp": chips},
                             multislice=ms, command=["x"], priority=prio,
-                            namespace=ns)
+                            namespace=ns,
+                            migratable=rng.random() < 0.3)
                     for k in range(size)]
             if rng.random() < 0.25:
                 pods = pods[:-1]   # trickle: last member arrives later (or

@@ -1,0 +1,146 @@
+"""Defragmentation via migratable gangs: the scheduler may relocate
+checkpointed workloads to compact space — only under a joint plan that
+proves the big gang fits AND every migrated gang re-places."""
+
+from kubegpu_tpu.allocator import GangRequest
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, PodPhase, pod_allocation
+from kubegpu_tpu.tpuplugin.backend import MILLICHIPS_PER_CHIP
+
+
+def block_origin(cl, name):
+    alloc = pod_allocation(cl.api.get("Pod", name))
+    return min(ch.coord for ch in alloc.chips)
+
+
+class TestMigration:
+    def _fragment_v5e16(self, cl):
+        """Fill all four host blocks with migratable 4-chip pods, then
+        complete the two on a DIAGONAL — the 8 free chips are left
+        disconnected, so an 8-chip gang can't place without migration."""
+        for n in "abcd":
+            cl.submit(tpu_pod(n, chips=4, command=["x"], migratable=True))
+        cl.step()
+        origins = {n: block_origin(cl, n) for n in "abcd"}
+        # find a diagonal pair of blocks (|dx| == |dy| == 2)
+        names = list(origins)
+        for i in range(4):
+            for j in range(i + 1, 4):
+                a, b = names[i], names[j]
+                dx = abs(origins[a][0] - origins[b][0])
+                dy = abs(origins[a][1] - origins[b][1])
+                if dx == 2 and dy == 2:
+                    for victim in (a, b):
+                        cl.api.delete("Pod", victim)
+                    return [n for n in names if n not in (a, b)]
+        raise AssertionError(f"no diagonal pair in {origins}")
+
+    def test_migration_compacts_disconnected_free_space(self):
+        cl = SimCluster(["v5e-16"])
+        survivors = self._fragment_v5e16(cl)
+        # 8 chips free but in two diagonal (disconnected) blocks
+        cl.submit(*[
+            tpu_pod(f"big-{i}", chips=4,
+                    gang=GangSpec(name="big", size=2, index=i),
+                    command=["x"])
+            for i in range(2)
+        ])
+        result, _ = cl.step()
+        assert {"big-0", "big-1"} <= set(result.scheduled), result
+        moved = [n for n in survivors
+                 if cl.pod_phase(n) == PodPhase.PENDING]
+        assert len(moved) == 1, moved   # minimal plan: one migrant
+        assert cl.metrics.snapshot()["counters"]["gangs_migrated"] == 1.0
+        # next pass: the migrant re-places in a freed diagonal block
+        result, _ = cl.step()
+        assert moved[0] in result.scheduled
+        # no over-commitment anywhere
+        for st in cl.scheduler.slices.values():
+            for used in st.used_millichips.values():
+                assert 0 <= used <= MILLICHIPS_PER_CHIP
+        cl.close()
+
+    def test_no_migration_without_opt_in(self):
+        cl = SimCluster(["v5e-16"])
+        for n in "abcd":
+            cl.submit(tpu_pod(n, chips=4, command=["x"]))  # not migratable
+        cl.step()
+        origins = {n: block_origin(cl, n) for n in "abcd"}
+        names = list(origins)
+        done = False
+        for i in range(4):
+            for j in range(i + 1, 4):
+                a, b = names[i], names[j]
+                if not done and (
+                        abs(origins[a][0] - origins[b][0]) == 2
+                        and abs(origins[a][1] - origins[b][1]) == 2):
+                    cl.api.delete("Pod", a)
+                    cl.api.delete("Pod", b)
+                    done = True
+        assert done
+        cl.submit(*[
+            tpu_pod(f"big-{i}", chips=4,
+                    gang=GangSpec(name="big", size=2, index=i),
+                    command=["x"])
+            for i in range(2)
+        ])
+        result, _ = cl.step()
+        assert {"big-0", "big-1"} <= set(result.unschedulable)
+        cl.close()
+
+    def test_no_migration_that_strands_the_migrant(self):
+        """If the migrated gang could not re-place anywhere, nobody
+        moves (the joint-closure check)."""
+        cl = SimCluster(["v4-8"])
+        cl.submit(tpu_pod("mova", chips=2, command=["x"], migratable=True))
+        cl.step()
+        cl.submit(tpu_pod("big", chips=4, command=["x"]))
+        result, _ = cl.step()
+        assert "big" in result.unschedulable
+        assert cl.pod_phase("mova") != PodPhase.PENDING
+        cl.close()
+
+    def test_migration_never_disturbs_higher_priority(self):
+        """Planner-level: a migratable gang above the requester's
+        priority is not a candidate; at equal priority it is."""
+        cl = SimCluster(["v4-8", "v4-8"])
+        cl.submit(tpu_pod("vip", chips=2, command=["x"], migratable=True,
+                          priority=10))
+        cl.step()
+        # pin 2 chips of the OTHER slice (a tenant big can't displace,
+        # but vip could co-tenant with)
+        vip_slice = pod_allocation(cl.api.get("Pod", "vip")).slice_id
+        other = next(st for sid, st in cl.scheduler.slices.items()
+                     if sid != vip_slice)
+        for ch in list(other.topo.chips)[:2]:
+            other.used_millichips[ch.coord] = MILLICHIPS_PER_CHIP
+        req = GangRequest("default/big", num_pods=1, chips_per_pod=4)
+        assert cl.scheduler._plan_migration(req, priority=0) is None
+        assert cl.scheduler._plan_migration(req, priority=10) \
+            == ["default/vip"]
+        cl.close()
+
+    def test_migrant_keeps_queue_seniority(self):
+        """Review regression: a migrated gang must not lose its FIFO
+        position — a later-submitted equal-priority pod must not steal
+        the home the migration plan proved for it."""
+        cl = SimCluster(["v5e-16"])
+        survivors = self._fragment_v5e16(cl)
+        cl.submit(*[
+            tpu_pod(f"big-{i}", chips=4,
+                    gang=GangSpec(name="big", size=2, index=i),
+                    command=["x"])
+            for i in range(2)
+        ])
+        # a later rival wanting the same 4-chip block the mover needs
+        cl.submit(tpu_pod("rival", chips=4, command=["x"]))
+        result, _ = cl.step()
+        assert {"big-0", "big-1"} <= set(result.scheduled)
+        moved = [n for n in survivors
+                 if cl.pod_phase(n) == PodPhase.PENDING]
+        assert len(moved) == 1
+        # next pass: the MOVER (senior) gets the freed block, not rival
+        result, _ = cl.step()
+        assert moved[0] in result.scheduled
+        assert cl.pod_phase("rival") == PodPhase.PENDING
+        cl.close()
