@@ -42,6 +42,7 @@ class GangRequest:
     num_pods: int = 1
     chips_per_pod: int = 0
     millitpu_per_pod: int = 0
+    hbm_gib_per_chip: float = 0.0  # min advertised HBM per allocated chip
     mesh_axes: dict[str, int] | None = None       # logical axes, ordered
     axis_weights: dict[str, float] | None = None  # relative collective bytes
     # permit splitting the gang across slices when no single slice fits:
@@ -140,6 +141,7 @@ class SliceState:
         self.bad_links: set[tuple[Coord, Coord]] = set()  # normalized pairs
         self.local_index: dict[Coord, int] = {}
         self.used_millichips: dict[Coord, int] = {}
+        self.hbm_gib: dict[Coord, float] = {}  # advertised HBM per chip
 
     @classmethod
     def from_advertisements(
@@ -161,6 +163,7 @@ class SliceState:
             for c in a.chips:
                 st.available.add(c.coord)
                 st.local_index[c.coord] = c.local_index
+                st.hbm_gib[c.coord] = c.hbm_gib
                 if not c.healthy:
                     st.unhealthy.add(c.coord)
             for pair in a.bad_links:
@@ -181,17 +184,23 @@ class SliceState:
         st.bad_links = set(self.bad_links)
         st.local_index = dict(self.local_index)
         st.used_millichips = dict(self.used_millichips)
+        st.hbm_gib = dict(self.hbm_gib)
         return st
 
     # -- occupancy -------------------------------------------------------
 
-    def blocked_for_whole(self) -> set[Coord]:
+    def blocked_for_whole(self, min_hbm_gib: float = 0.0) -> set[Coord]:
         """Coords unusable for whole-chip placement: any current use,
-        unhealthy, or not advertised (host missing)."""
+        unhealthy, not advertised (host missing), or — with
+        ``min_hbm_gib`` — advertising less HBM than the request needs
+        (a chip the model doesn't fit on is no chip at all)."""
         blocked = {c for c, u in self.used_millichips.items() if u > 0}
         blocked |= self.unhealthy
         all_coords = {ch.coord for ch in self.topo.chips}
         blocked |= all_coords - self.available
+        if min_hbm_gib > 0:
+            blocked |= {c for c in self.available
+                        if self.hbm_gib.get(c, 0.0) < min_hbm_gib}
         return blocked
 
     def free_millichips(self, coord: Coord) -> int:
@@ -229,6 +238,7 @@ class SliceState:
         view.bad_links = set(self.bad_links)
         view.local_index = dict(self.local_index)
         view.used_millichips = dict(self.used_millichips)
+        view.hbm_gib = dict(self.hbm_gib)
         return view
 
     def fill_fraction(self) -> float:
@@ -640,7 +650,7 @@ class GangAllocator:
         cph = st.spec.chips_per_host
         if req.chips_per_pod > cph:
             return None  # a pod cannot span hosts
-        blocked = st.blocked_for_whole()
+        blocked = st.blocked_for_whole(req.hbm_gib_per_chip)
         fill = st.fill_fraction()
         axes = req.mesh_axes or {"dp": total}
         best: _Candidate | None = None
@@ -803,6 +813,7 @@ class GangAllocator:
                 gang_name=req.gang_name,
                 num_pods=req.num_pods // n_parts,
                 chips_per_pod=req.chips_per_pod,
+                hbm_gib_per_chip=req.hbm_gib_per_chip,
                 mesh_axes=sub_axes,
                 axis_weights=req.axis_weights)
             cands = []
@@ -858,6 +869,9 @@ class GangAllocator:
                 free = st.free_millichips(coord)
                 used = st.used_millichips.get(coord, 0)
                 if free < need:
+                    continue
+                if req.hbm_gib_per_chip > 0 and \
+                        st.hbm_gib.get(coord, 0.0) < req.hbm_gib_per_chip:
                     continue
                 corner_dist = (min(coord[0], mx - 1 - coord[0])
                                + min(coord[1], my - 1 - coord[1])

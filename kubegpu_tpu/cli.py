@@ -68,6 +68,7 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
         gang = entry.get("gang")
         chips = int(entry.get("chips", 0))
         millitpu = int(entry.get("millitpu", 0))
+        hbm_gib = float(entry.get("hbm_gib", 0.0))
         axes = entry.get("mesh_axes")
         if axes is not None:
             axes = {str(k): int(v) for k, v in axes.items()}
@@ -80,7 +81,8 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
             pods.append(tpu_pod(name, chips=chips, millitpu=millitpu,
                                 mesh_axes=axes, command=command, env=env,
                                 priority=priority, multislice=multislice,
-                                namespace=namespace, migratable=migratable))
+                                namespace=namespace, migratable=migratable,
+                                hbm_gib=hbm_gib))
             continue
         if isinstance(gang, int):
             gang = {"size": gang}
@@ -92,7 +94,8 @@ def pods_from_spec(spec: dict) -> tuple[list, list[str]]:
                 gang=GangSpec(name=gname, size=size, index=i),
                 mesh_axes=axes, command=command, env=env,
                 priority=priority, multislice=multislice,
-                namespace=namespace, migratable=migratable))
+                namespace=namespace, migratable=migratable,
+                hbm_gib=hbm_gib))
     return pods, slices
 
 

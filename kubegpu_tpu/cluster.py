@@ -51,7 +51,8 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
             priority: int = 0,
             multislice: bool = False,
             namespace: str = "default",
-            migratable: bool = False) -> Pod:
+            migratable: bool = False,
+            hbm_gib: float = 0.0) -> Pod:
     """Pod-spec builder — the user surface (reference: example/ YAML)."""
     pod = Pod(
         metadata=ObjectMeta(name=name, namespace=namespace),
@@ -59,7 +60,8 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
             name="main",
             command=command or [],
             env=env or {},
-            resources=ResourceRequests(tpu_chips=chips, millitpu=millitpu),
+            resources=ResourceRequests(tpu_chips=chips, millitpu=millitpu,
+                                       hbm_gib=hbm_gib),
         )], priority=priority),
     )
     if gang is not None:
@@ -147,17 +149,19 @@ class SimCluster:
             self.api.create("Pod", p)
 
     def set_quota(self, namespace: str, chips: int | None = None,
-                  millitpu: int | None = None) -> None:
-        """Create/replace the namespace's device quota (k8s ResourceQuota
-        parity — the scheduler denies asks that would exceed it)."""
+                  millitpu: int | None = None, name: str = "quota") -> None:
+        """Create/replace a device quota object (k8s ResourceQuota
+        parity — the scheduler denies asks that would exceed it).
+        Several quota objects may coexist in one namespace; each enforces
+        independently, so the tightest limit wins."""
         from kubegpu_tpu.kubemeta import NotFound, Quota, QuotaSpec
 
         try:
-            self.api.delete("Quota", "quota", namespace=namespace)
+            self.api.delete("Quota", name, namespace=namespace)
         except NotFound:
             pass
         self.api.create("Quota", Quota(
-            metadata=ObjectMeta(name="quota", namespace=namespace),
+            metadata=ObjectMeta(name=name, namespace=namespace),
             spec=QuotaSpec(tpu_chips=chips, millitpu=millitpu)))
 
     def step(self):

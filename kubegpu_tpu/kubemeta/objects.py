@@ -19,6 +19,7 @@ _uid_counter = itertools.count(1)
 # Resource names (user surface, pod spec `resources`):
 RES_TPU_CHIPS = "kubetpu.io/tpu-chips"     # whole chips per container
 RES_MILLITPU = "kubetpu.io/millitpu"       # fractional chip, 1000 = 1 chip
+RES_HBM_GIB = "kubetpu.io/hbm-gib"         # min HBM per allocated chip
 
 
 class PodPhase(str, enum.Enum):
@@ -52,25 +53,33 @@ class ResourceRequests:
 
     tpu_chips: int = 0
     millitpu: int = 0  # fractional ask; mutually exclusive with tpu_chips
+    # Minimum HBM (GiB) each allocated chip must advertise — the per-chip
+    # capacity dimension beyond chip count (reference tracked per-device
+    # memory in its capacity lists, SURVEY.md §3 NodeInfo{Capacity}).
+    # 0 = no requirement.
+    hbm_gib: float = 0.0
 
     def __post_init__(self) -> None:
         if self.tpu_chips and self.millitpu:
             raise ValueError("request either whole tpu-chips or millitpu, not both")
-        if self.tpu_chips < 0 or self.millitpu < 0:
+        if self.tpu_chips < 0 or self.millitpu < 0 or self.hbm_gib < 0:
             raise ValueError("negative device request")
 
-    def to_dict(self) -> dict[str, int]:
-        out = {}
+    def to_dict(self) -> dict[str, float]:
+        out: dict[str, float] = {}
         if self.tpu_chips:
             out[RES_TPU_CHIPS] = self.tpu_chips
         if self.millitpu:
             out[RES_MILLITPU] = self.millitpu
+        if self.hbm_gib:
+            out[RES_HBM_GIB] = self.hbm_gib
         return out
 
     @classmethod
-    def from_dict(cls, d: dict[str, int]) -> "ResourceRequests":
+    def from_dict(cls, d: dict[str, float]) -> "ResourceRequests":
         return cls(tpu_chips=int(d.get(RES_TPU_CHIPS, 0)),
-                   millitpu=int(d.get(RES_MILLITPU, 0)))
+                   millitpu=int(d.get(RES_MILLITPU, 0)),
+                   hbm_gib=float(d.get(RES_HBM_GIB, 0.0)))
 
 
 @dataclass
@@ -86,7 +95,8 @@ class ContainerSpec:
             name=self.name, command=list(self.command), image=self.image,
             env=dict(self.env),
             resources=ResourceRequests(tpu_chips=self.resources.tpu_chips,
-                                       millitpu=self.resources.millitpu))
+                                       millitpu=self.resources.millitpu,
+                                       hbm_gib=self.resources.hbm_gib))
 
 
 @dataclass
@@ -121,6 +131,13 @@ class PodSpec:
     @property
     def total_millitpu(self) -> int:
         return sum(c.resources.millitpu for c in self.containers)
+
+    @property
+    def max_hbm_gib(self) -> float:
+        """The pod's per-chip HBM floor: every allocated chip must
+        advertise at least the strictest container's requirement."""
+        return max((c.resources.hbm_gib for c in self.containers),
+                   default=0.0)
 
     def clone(self) -> "PodSpec":
         return PodSpec(containers=[c.clone() for c in self.containers],

@@ -52,11 +52,19 @@ class QTensor:
 
     def __rmatmul__(self, x: jax.Array) -> jax.Array:
         # (x @ int8-as-activation-dtype) * scale: the cast and scale fuse
-        # into the matmul; weight traffic from HBM stays int8.  The
-        # contracted (second-to-last) axis drops out of the product, so
-        # drop its size-1 slot from the kept-dims scale too.
-        scale = jnp.squeeze(self.scale.astype(x.dtype), axis=-2) \
-            if self.scale.ndim >= 2 else self.scale.astype(x.dtype)
+        # into the matmul; weight traffic from HBM stays int8.
+        scale = self.scale.astype(x.dtype)
+        if x.ndim == 1 and scale.ndim >= 2:
+            # A 1-D x contributes no batch dim, so the product collapses
+            # to [..., out] with the contracted slot GONE — drop its
+            # size-1 slot from the kept-dims scale or broadcasting would
+            # resurrect it ([out]*[1,out] → [1,out]; [L,out]*[L,1,out]
+            # → [L,L,out]).
+            scale = jnp.squeeze(scale, axis=-2)
+        # Batched x keeps the kept-dims scale as-is: the contracted slot
+        # broadcasts over x's batch dim ([B,out]*[1,out] is fine, and
+        # stacked [L,in,out] values give [L,B,out]*[L,1,out] — squeezing
+        # to [L,out] there would mis-align L with B).
         return (x @ self.values.astype(x.dtype)) * scale
 
     def __matmul__(self, other):  # pragma: no cover - weights are RHS

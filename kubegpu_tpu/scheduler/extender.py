@@ -406,6 +406,7 @@ class DeviceScheduler:
                 num_pods=pg.spec.size,
                 chips_per_pod=chips,
                 millitpu_per_pod=member.spec.total_millitpu,
+                hbm_gib_per_chip=member.spec.max_hbm_gib,
                 mesh_axes=self._sane_axes(pod_mesh_axes(member),
                                           pg.spec.size * chips))
         except ValueError:
@@ -468,25 +469,40 @@ class DeviceScheduler:
         self.metrics.inc("schedule_invalid")
         self.trace.record("invalid", gang=gang, detail={"reason": reason})
 
+    def _effective_quota(self, ns: str):
+        """Combined namespace budget — k8s ResourceQuota parity: EVERY
+        quota object in the namespace enforces independently, so the
+        effective limit per resource is the MINIMUM across the objects
+        that specify it.  Returns a QuotaSpec, or None when the
+        namespace has no quota objects (unlimited)."""
+        from kubegpu_tpu.kubemeta import QuotaSpec
+
+        quotas = self.api.list("Quota", namespace=ns)
+        if not quotas:
+            return None
+        chips = [q.spec.tpu_chips for q in quotas
+                 if q.spec.tpu_chips is not None]
+        milli = [q.spec.millitpu for q in quotas
+                 if q.spec.millitpu is not None]
+        return QuotaSpec(tpu_chips=min(chips) if chips else None,
+                         millitpu=min(milli) if milli else None)
+
     def _quota_violation(self, members: list[Pod],
                          req: GangRequest) -> str | None:
         """Namespace ResourceQuota check (k8s parity): would admitting
-        this gang push the namespace's LIVE device usage past its Quota
-        object?  Usage is computed from annotation truth, so it survives
-        scheduler restarts like everything else.  Returns the human
-        reason, or None when within budget."""
-        from kubegpu_tpu.kubemeta import NotFound
-
+        this gang push the namespace's LIVE device usage past its
+        combined quota?  Usage is computed from annotation truth, so it
+        survives scheduler restarts like everything else.  Returns the
+        human reason, or None when within budget."""
         ns = members[0].metadata.namespace
-        try:
-            quota = self.api.get("Quota", "quota", namespace=ns)
-        except NotFound:
-            return None   # no quota object → unlimited
+        quota = self._effective_quota(ns)
+        if quota is None:
+            return None   # no quota objects → unlimited
         ask_chips = req.total_chips
         ask_milli = req.num_pods * req.millitpu_per_pod
         used_chips, used_milli, _ = self._namespace_usage(ns)
-        limit_c = quota.spec.tpu_chips
-        limit_m = quota.spec.millitpu
+        limit_c = quota.tpu_chips
+        limit_m = quota.millitpu
         if limit_c is not None and used_chips + ask_chips > limit_c:
             return (f"namespace {ns} chip quota: {used_chips} used + "
                     f"{ask_chips} requested > {limit_c}")
@@ -734,11 +750,8 @@ class DeviceScheduler:
         capacity preemption) — no eviction set is returned unless the
         whole plan succeeds, so quota pressure never thrash-kills gangs
         it cannot benefit from."""
-        from kubegpu_tpu.kubemeta import NotFound
-
-        try:
-            quota = self.api.get("Quota", "quota", namespace=ns)
-        except NotFound:
+        quota = self._effective_quota(ns)
+        if quota is None:
             return None
         idx = {g: i for i, g in enumerate(self._committed)}
         order = sorted(
@@ -750,11 +763,11 @@ class DeviceScheduler:
         used_c, used_m, gang_usage = self._namespace_usage(ns)
 
         def fits(c: int, m: int) -> bool:
-            if quota.spec.tpu_chips is not None \
-                    and c + need_c > quota.spec.tpu_chips:
+            if quota.tpu_chips is not None \
+                    and c + need_c > quota.tpu_chips:
                 return False
-            if quota.spec.millitpu is not None \
-                    and m + need_m > quota.spec.millitpu:
+            if quota.millitpu is not None \
+                    and m + need_m > quota.millitpu:
                 return False
             return True
 
@@ -818,6 +831,11 @@ class DeviceScheduler:
             return GangRequest(
                 gang_name=gang, num_pods=len(asg.pods),
                 chips_per_pod=chips_per_pod,
+                # max across members — must match _request_for_gang's
+                # floor or a migration plan could 'close' on chips the
+                # real re-schedule then rejects (stranding the mover)
+                hbm_gib_per_chip=max(
+                    (p.spec.max_hbm_gib for p in members), default=0.0),
                 mesh_axes=self._sane_axes(
                     axes, len(asg.pods) * chips_per_pod),
                 allow_multislice=bool(members)
@@ -959,6 +977,7 @@ class DeviceScheduler:
             num_pods=1,
             chips_per_pod=chips,
             millitpu_per_pod=pod.spec.total_millitpu,
+            hbm_gib_per_chip=pod.spec.max_hbm_gib,
             mesh_axes=self._sane_axes(pod_mesh_axes(pod), chips),
         )
 
@@ -974,6 +993,7 @@ class DeviceScheduler:
             num_pods=len(members),
             chips_per_pod=chips,
             millitpu_per_pod=milli.pop(),
+            hbm_gib_per_chip=max(p.spec.max_hbm_gib for p in members),
             mesh_axes=self._sane_axes(pod_mesh_axes(members[0]),
                                       len(members) * chips),
             allow_multislice=pod_multislice(members[0]),

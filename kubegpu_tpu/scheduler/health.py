@@ -155,6 +155,11 @@ class FaultRecoveryController:
             req = GangRequest(
                 gang_name=gang, num_pods=len(asg.pods),
                 chips_per_pod=chips_per_pod,
+                # same HBM floor the real re-schedule will enforce — an
+                # 'alternative' on low-HBM chips would evict toward a
+                # placement _request_for_gang then rejects (stranding)
+                hbm_gib_per_chip=max(
+                    (p.spec.max_hbm_gib for p in members), default=0.0),
                 mesh_axes=self.scheduler._sane_axes(
                     axes, len(asg.pods) * chips_per_pod),
                 # a multislice gang's alternative may also be multislice

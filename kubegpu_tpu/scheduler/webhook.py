@@ -53,7 +53,7 @@ def pod_from_doc(doc: dict) -> Pod:
             env={e["name"]: str(e.get("value", ""))
                  for e in c.get("env") or []},
             resources=ResourceRequests.from_dict(
-                {k: int(v) for k, v in requests.items()
+                {k: float(v) for k, v in requests.items()
                  if k.startswith("kubetpu.io/")}),
         ))
     return Pod(

@@ -53,6 +53,10 @@ def config4_llama_v5e16(steps: int = 2) -> tuple[list[Pod], list[str]]:
         tpu_pod(f"llama-{i}", chips=4,
                 gang=GangSpec(name="llama-8b", size=4, index=i),
                 mesh_axes={"dp": 4, "tp": 4},
+                # Llama-3-8B sharded 4-way tp: ~4 GiB weights + optimizer
+                # + activations per chip — any v5e chip (16 GiB) clears it;
+                # declared so HBM-aware admission is exercised end-to-end
+                hbm_gib=8.0,
                 command=_prog("llama_pjit"),
                 env={"LLAMA_STEPS": str(steps)})
         for i in range(4)

@@ -1,0 +1,89 @@
+"""HBM-aware admission (VERDICT r1 #5): the per-chip memory capacity is
+a scheduling dimension — a gang whose model doesn't fit a chip's HBM must
+not schedule there (reference tracked per-device memory in its capacity
+lists, SURVEY.md §3 core types)."""
+
+from kubegpu_tpu.cluster import SimCluster, tpu_pod
+from kubegpu_tpu.kubemeta import GangSpec, PodPhase
+from kubegpu_tpu.kubemeta.objects import ResourceRequests
+
+
+class TestHbmAdmission:
+    def test_oversized_ask_unschedulable_on_small_hbm(self):
+        """A 95 GiB/chip ask fits v5p (95 GiB chips) but must be
+        unschedulable on v5e-16 (16 GiB chips)."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(tpu_pod("big", chips=4, hbm_gib=95.0, command=["x"]))
+        result, _ = cl.step()
+        assert "big" in result.unschedulable
+        cl.close()
+
+    def test_oversized_ask_lands_on_v5p(self):
+        cl = SimCluster(["v5e-16", "v5p-128"])
+        cl.submit(tpu_pod("big", chips=4, hbm_gib=95.0, command=["x"]))
+        result, _ = cl.step()
+        assert "big" in result.scheduled
+        pod = cl.api.get("Pod", "big")
+        assert pod.spec.node_name.startswith("v5p-128")
+        cl.close()
+
+    def test_small_ask_unconstrained(self):
+        """No hbm_gib declared → schedules anywhere (back-compat)."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(tpu_pod("ok", chips=4, command=["x"]))
+        result, _ = cl.step()
+        assert "ok" in result.scheduled
+        cl.close()
+
+    def test_gang_hbm_floor_applies_to_every_member(self):
+        cl = SimCluster(["v5e-16", "v5p-128"])
+        cl.submit(*[
+            tpu_pod(f"g-{i}", chips=4, hbm_gib=40.0,
+                    gang=GangSpec(name="g", size=4, index=i),
+                    command=["x"])
+            for i in range(4)
+        ])
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4
+        for i in range(4):
+            pod = cl.api.get("Pod", f"g-{i}")
+            assert pod.spec.node_name.startswith("v5p-128")
+        cl.close()
+
+    def test_fractional_ask_respects_hbm(self):
+        cl = SimCluster(["v5e-16"])
+        cl.submit(tpu_pod("frac", millitpu=500, hbm_gib=95.0,
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "frac" in result.unschedulable
+        cl.submit(tpu_pod("frac-ok", millitpu=500, hbm_gib=8.0,
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "frac-ok" in result.scheduled
+        cl.close()
+
+    def test_hbm_survives_resource_dict_roundtrip(self):
+        r = ResourceRequests(tpu_chips=2, hbm_gib=24.5)
+        assert ResourceRequests.from_dict(r.to_dict()) == r
+
+    def test_preemption_only_frees_chips_that_help(self):
+        """A high-priority 95 GiB ask on a v5e-only cluster must stay
+        unschedulable WITHOUT evicting the low-priority tenant — no chip
+        in the cluster can ever satisfy it, so eviction buys nothing."""
+        cl = SimCluster(["v5e-16"])
+        cl.submit(*[
+            tpu_pod(f"low-{i}", chips=4,
+                    gang=GangSpec(name="low", size=4, index=i),
+                    command=["x"], priority=0)
+            for i in range(4)
+        ])
+        result, _ = cl.step()
+        assert len(result.scheduled) == 4
+        cl.submit(tpu_pod("big", chips=4, hbm_gib=95.0, command=["x"],
+                          priority=10))
+        result, _ = cl.step()
+        assert "big" in result.unschedulable
+        for i in range(4):
+            low = cl.api.get("Pod", f"low-{i}")
+            assert low.status.phase != PodPhase.PENDING  # not thrash-evicted
+        cl.close()

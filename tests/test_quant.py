@@ -41,6 +41,40 @@ class TestQTensor:
                                    np.asarray(x @ q.dequantize()),
                                    atol=1e-5, rtol=1e-5)
 
+    def test_stacked_matmul_outside_scan(self):
+        """Advisor regression: [L, in, out] stacked weights used
+        directly (outside lax.scan) must scale per layer, not collide
+        the layer dim with the batch dim — including when B == L."""
+        L, B, cin, cout = 3, 3, 8, 5   # B == L: the silent-mis-scale case
+        w = jax.random.normal(jax.random.PRNGKey(5), (L, cin, cout))
+        x = jax.random.normal(jax.random.PRNGKey(6), (B, cin))
+        q = quantize(w, batch_dims=1)
+        out = x @ q
+        assert out.shape == (L, B, cout)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(jnp.einsum("bi,lio->lbo", x, q.dequantize())),
+            atol=1e-4, rtol=1e-4)
+
+    def test_1d_x_against_2d_and_stacked(self):
+        """Review regression: a 1-D x has no batch dim, so the kept-dims
+        scale must be squeezed or broadcasting resurrects the contracted
+        slot ([out]*[1,out]→[1,out]; [L,out]*[L,1,out]→[L,L,out])."""
+        x = jax.random.normal(jax.random.PRNGKey(7), (8,))
+        w2 = jax.random.normal(jax.random.PRNGKey(8), (8, 5))
+        q2 = quantize(w2)
+        assert (x @ q2).shape == (5,)
+        np.testing.assert_allclose(np.asarray(x @ q2),
+                                   np.asarray(x @ q2.dequantize()),
+                                   atol=1e-5, rtol=1e-5)
+        w3 = jax.random.normal(jax.random.PRNGKey(9), (3, 8, 5))
+        q3 = quantize(w3, batch_dims=1)
+        assert (x @ q3).shape == (3, 5)
+        np.testing.assert_allclose(
+            np.asarray(x @ q3),
+            np.asarray(jnp.einsum("i,lio->lo", x, q3.dequantize())),
+            atol=1e-4, rtol=1e-4)
+
     def test_jit_and_pytree(self):
         w = jax.random.normal(jax.random.PRNGKey(4), (8, 8))
         q = quantize(w)

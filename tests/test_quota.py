@@ -78,6 +78,42 @@ class TestQuota:
         assert "f2" in result.unschedulable
         cl.close()
 
+    def test_multiple_quota_objects_tightest_wins(self):
+        """k8s parity: every ResourceQuota in a namespace enforces
+        independently, so two quota objects combine to the tighter
+        limit — not just one conventionally-named object."""
+        cl = SimCluster(["v5e-16"])
+        cl.set_quota("team-a", chips=8, name="quota-wide")
+        cl.set_quota("team-a", chips=4, name="quota-tight")
+        cl.submit(tpu_pod("a1", chips=4, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "a1" in result.scheduled
+        # 4 more chips fit the wide quota (8) but not the tight one (4)
+        cl.submit(tpu_pod("a2", chips=4, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "a2" in result.unschedulable
+        cl.close()
+
+    def test_multiple_quotas_combine_per_resource(self):
+        """Limits combine per RESOURCE: one object may cap chips and
+        another millitpu; both apply."""
+        cl = SimCluster(["v4-8"])
+        cl.set_quota("team-a", chips=2, name="chips-cap")
+        cl.set_quota("team-a", millitpu=400, name="frac-cap")
+        cl.submit(tpu_pod("w", chips=2, namespace="team-a",
+                          command=["x"]))
+        cl.submit(tpu_pod("f", millitpu=300, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert {"w", "f"} <= set(result.scheduled)
+        cl.submit(tpu_pod("f2", millitpu=200, namespace="team-a",
+                          command=["x"]))
+        result, _ = cl.step()
+        assert "f2" in result.unschedulable
+        cl.close()
+
     def test_spec_file_quotas_section(self, tmp_path):
         from kubegpu_tpu.cli import main
         spec = tmp_path / "q.yaml"
