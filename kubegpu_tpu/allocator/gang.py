@@ -596,12 +596,19 @@ class GangAllocator:
     testability property the reference's allocator had (SURVEY.md §5)."""
 
     def __init__(self, max_placements_per_shape: int = 64,
+                 max_scored_per_shape: int = 8,
                  locality_weight: float = 0.6, frag_weight: float = 0.25,
                  fill_weight: float = 0.15):
         self.max_placements_per_shape = max_placements_per_shape
+        self.max_scored_per_shape = max_scored_per_shape
         self.locality_weight = locality_weight
         self.frag_weight = frag_weight
         self.fill_weight = fill_weight
+        # Load (and if stale, rebuild) the native core NOW, not inside
+        # the first scheduling decision — the lazy path costs ms (or a
+        # `make` run) and would land in the latency histogram's tail.
+        from kubegpu_tpu.allocator import _native
+        _native.available()
 
     # -- public API ------------------------------------------------------
 
@@ -651,17 +658,50 @@ class GangAllocator:
         if req.chips_per_pod > cph:
             return None  # a pod cannot span hosts
         blocked = st.blocked_for_whole(req.hbm_gib_per_chip)
+        # Exact necessary condition, O(chips): fewer FREE chips than the
+        # ask means no shape can ever place — skip the whole shape ×
+        # placement × ordering search.  This is the failing-decision hot
+        # path (the p99 tail is made of infeasible searches).
+        if total > len(st.available - blocked):
+            return None
         fill = st.fill_fraction()
         axes = req.mesh_axes or {"dp": total}
+        # Branch-and-bound over placements: the ordering search (the
+        # expensive part of scoring) is bounded above by locality=1.0,
+        # so computing the CHEAP fragmentation term for every placement
+        # first and visiting in descending-frag order lets us stop the
+        # moment no remaining placement's bound can beat the incumbent.
+        # Exact: the winner is the same as scoring everything (ties may
+        # resolve to an equal-scored placement).  This is what keeps the
+        # empty-cluster small-gang case (many placements) off the p99.
+        ranked: list[tuple[float, int, Placement]] = []
+        for si, shape in enumerate(subslice_shapes(
+                total, st.spec.mesh_shape)):
+            shape_ranked = [
+                (fragmentation_score(st.topo, blocked, pl), si, pl)
+                for pl in find_free_placements(
+                    st.topo, blocked, shape,
+                    limit=self.max_placements_per_shape)]
+            # Only the top-frag few per shape get the expensive ordering
+            # search: on a homogeneous torus, locality depends on the
+            # shape far more than the origin, so the frag ranking is the
+            # score ranking to within ties — every shape stays
+            # represented, and the global bound below still applies.
+            shape_ranked.sort(key=lambda t: -t[0])
+            ranked.extend(shape_ranked[:self.max_scored_per_shape])
+        # stable: frag desc, then the shape-compactness preference order
+        ranked.sort(key=lambda t: (-t[0], t[1]))
         best: _Candidate | None = None
-        for shape in subslice_shapes(total, st.spec.mesh_shape):
-            placements = find_free_placements(
-                st.topo, blocked, shape,
-                limit=self.max_placements_per_shape)
-            for pl in placements:
-                cand = self._score_placement(st, pl, req, axes, blocked, fill)
-                if cand and (best is None or cand.score > best.score):
-                    best = cand
+        for frag, _, pl in ranked:
+            bound = 10.0 * (self.locality_weight
+                            + self.frag_weight * frag
+                            + self.fill_weight * fill)
+            if best is not None and bound <= best.score:
+                break
+            cand = self._score_placement(st, pl, req, axes, blocked, fill,
+                                         frag=frag)
+            if cand and (best is None or cand.score > best.score):
+                best = cand
         if best is None:
             # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) fall back
             # to a connected free set — the reference's group allocator had
@@ -745,7 +785,8 @@ class GangAllocator:
     def _score_placement(self, st: SliceState, pl: Placement,
                          req: GangRequest, axes: dict[str, int],
                          blocked: set[Coord],
-                         fill: float) -> _Candidate | None:
+                         fill: float,
+                         frag: float | None = None) -> _Candidate | None:
         c = req.chips_per_pod
         ring_span = list(axes.values())[-1] if axes else None
         orders = [o for o in
@@ -759,7 +800,8 @@ class GangAllocator:
                                  st.bad_links)
             if loc > best_loc:
                 best_order, best_loc = o, loc
-        frag = fragmentation_score(st.topo, blocked, pl)
+        if frag is None:
+            frag = fragmentation_score(st.topo, blocked, pl)
         score = 10.0 * (self.locality_weight * best_loc
                         + self.frag_weight * frag
                         + self.fill_weight * fill)

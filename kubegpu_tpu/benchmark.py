@@ -100,8 +100,11 @@ def run_bench(n_gangs: int = 60, seed: int = 0) -> dict:
         "details": {
             "p90_ms": round(hist.get("p90", 0.0), 3),
             "p99_ms": round(hist.get("p99", 0.0), 3),
+            # the histogram covers EVERY decision, failed ones included —
+            # the expensive infeasible searches are in the percentiles
             "decisions": hist.get("count", 0),
             "gangs_scheduled": snap["counters"].get("gangs_scheduled", 0),
+            "decisions_failed": snap["counters"].get("gangs_failed", 0),
             "unschedulable": snap["counters"].get(
                 "schedule_unschedulable", 0),
             "mean_allocation_locality": round(loc.get("mean", 0.0), 4),
