@@ -15,6 +15,7 @@ stress-tested in tests/test_controlplane.py).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterator
 
@@ -48,6 +49,16 @@ class FakeApiServer:
             "Pod": _Store(), "Node": _Store(), "Quota": _Store()}
         self._watchers: list[Callable[[WatchEvent], None]] = []
         self._rv = 0
+        # Watch delivery happens OUTSIDE self._lock: a callback that
+        # re-enters a component holding its own lock (the scheduler's)
+        # while another thread holds that lock and calls into the
+        # apiserver would otherwise ABBA-deadlock.  Events queue under
+        # self._lock (FIFO order fixed by mutation order) and a single
+        # drainer at a time delivers them; _drain never blocks on the
+        # delivery lock, so a thread that queued while a drain is active
+        # just leaves its event for the active drainer.
+        self._delivery = threading.Lock()
+        self._pending_events: deque[WatchEvent] = deque()
 
     # -- internals -------------------------------------------------------
 
@@ -56,8 +67,24 @@ class FakeApiServer:
         obj.metadata.resource_version = self._rv
 
     def _notify(self, ev: WatchEvent) -> None:
-        for w in list(self._watchers):
-            w(ev)
+        """Queue an event (called under self._lock); delivery is via
+        :meth:`_drain` after the mutator releases the lock."""
+        self._pending_events.append(ev)
+
+    def _drain(self) -> None:
+        if not self._delivery.acquire(blocking=False):
+            return   # an active drainer will deliver our queued event
+        try:
+            while True:
+                with self._lock:
+                    if not self._pending_events:
+                        return
+                    ev = self._pending_events.popleft()
+                    watchers = list(self._watchers)
+                for w in watchers:
+                    w(ev)
+        finally:
+            self._delivery.release()
 
     @staticmethod
     def _key(namespace: str, name: str) -> str:
@@ -74,7 +101,9 @@ class FakeApiServer:
             self._bump(obj)
             store.objects[key] = obj.clone()
             self._notify(WatchEvent(kind, "ADDED", obj.clone()))
-            return obj.clone()
+            out = obj.clone()
+        self._drain()
+        return out
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         with self._lock:
@@ -133,7 +162,9 @@ class FakeApiServer:
             self._bump(obj)
             store.objects[key] = obj.clone()
             self._notify(WatchEvent(kind, "MODIFIED", obj.clone()))
-            return obj.clone()
+            out = obj.clone()
+        self._drain()
+        return out
 
     def patch_annotations(self, kind: str, name: str,
                           annotations: dict[str, str],
@@ -151,7 +182,9 @@ class FakeApiServer:
             obj.metadata.annotations.update(annotations)
             self._bump(obj)
             self._notify(WatchEvent(kind, "MODIFIED", obj.clone()))
-            return obj.clone()
+            out = obj.clone()
+        self._drain()
+        return out
 
     def bind_pod(self, name: str, node_name: str,
                  namespace: str = "default") -> None:
@@ -166,6 +199,7 @@ class FakeApiServer:
             pod.status.phase = PodPhase.SCHEDULED
             self._bump(pod)
             self._notify(WatchEvent("Pod", "MODIFIED", pod.clone()))
+        self._drain()
 
     def set_pod_phase(self, name: str, phase, message: str = "",
                       exit_code: int | None = None,
@@ -189,6 +223,7 @@ class FakeApiServer:
                 pod.status.exit_code = exit_code
             self._bump(pod)
             self._notify(WatchEvent("Pod", "MODIFIED", pod.clone()))
+        self._drain()
 
     def set_node_ready(self, name: str, ready: bool,
                        namespace: str = "default") -> None:
@@ -205,6 +240,7 @@ class FakeApiServer:
             node.status.ready = ready
             self._bump(node)
             self._notify(WatchEvent("Node", "MODIFIED", node.clone()))
+        self._drain()
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         with self._lock:
@@ -214,6 +250,7 @@ class FakeApiServer:
                 raise NotFound(f"{kind} {key}")
             obj = store.objects.pop(key)
             self._notify(WatchEvent(kind, "DELETED", obj.clone()))
+        self._drain()
 
     # -- watch -----------------------------------------------------------
 

@@ -30,6 +30,7 @@ Queue policy (k8s scheduler semantics, TPU-gang flavored):
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -110,6 +111,20 @@ class DeviceScheduler:
         # what-if machinery as the barrier) until it re-places, so no
         # other unit — same pass or later — can take its proven home
         self._migration_debts: dict[str, GangRequest] = {}
+        # Wire-path (webhook) gang assumption state: gkey → per-pod
+        # (node, Allocation) decisions computed when the LAST member
+        # arrived at /filter; occupancy is committed ("assumed") at that
+        # moment so later wire/in-process decisions see it.  In-memory
+        # only — sync() drops unfulfilled assumptions (unbound chips are
+        # absent from annotation truth, so they free automatically).
+        self._wire_assumed: dict[str, dict[str, tuple[str, object]]] = {}
+        self._wire_assumed_at: dict[str, float] = {}
+        self._wire_bound: dict[str, set[str]] = {}
+        # One lock for every public entry point: the webhook's threaded
+        # HTTP handlers, an embedded control loop, and advertiser ticks
+        # may call in concurrently (advisor r1 finding).  RLock because
+        # entry points call each other (evict→return_pod_resources).
+        self._lock = threading.RLock()
         self.sync()
 
     # ------------------------------------------------------------------
@@ -143,7 +158,17 @@ class DeviceScheduler:
     def sync(self) -> None:
         """Rebuild slice states from Node advertisements and re-apply every
         live pod's allocation — the restart-recovery path (SURVEY.md §4.4:
-        annotations, not memory, are the source of truth)."""
+        annotations, not memory, are the source of truth).  Unfulfilled
+        wire-path gang assumptions are dropped: their unbound chips exist
+        nowhere in annotation truth, so they free here, and the external
+        scheduler's next /filter re-assumes from live state."""
+        with self._lock:
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
+        self._wire_assumed.clear()
+        self._wire_assumed_at.clear()
+        self._wire_bound.clear()
         advs: dict[str, list[NodeAdvertisement]] = {}
         for node in self.api.list("Node"):
             if not node.status.ready:
@@ -205,61 +230,359 @@ class DeviceScheduler:
         """Cheap re-sync on node add/remove/health events."""
         self.sync()
 
+    # (sync/filter/prioritize/bind/run_once/return_pod_resources/
+    # evict_gang all serialize on self._lock — the webhook's threaded
+    # handlers and an embedded control loop may call in concurrently.)
+
     # ------------------------------------------------------------------
     # Extender verbs (webhook API parity — SURVEY.md §3 extender service)
     # ------------------------------------------------------------------
 
     def filter(self, pod: Pod, node_names: list[str]) -> tuple[list[str], dict[str, str]]:
-        """Predicate: which candidate nodes could host this pod (as a
-        1-pod gang)?  Feasibility is judged against each node's *own*
-        chips (a restricted slice view), matching the extender contract
-        that /filter answers per-node."""
+        """Predicate: which candidate nodes could host this pod?
+        Singles are judged against each node's *own* chips (a restricted
+        slice view), matching the extender contract that /filter answers
+        per-node.  GANG members go through hold-and-assume: until every
+        member exists, all nodes fail with a "gang waiting" reason (the
+        external scheduler's retry loop is the arrival barrier — the
+        coscheduling-plugin pattern); once complete, one whole-gang
+        assignment is computed and committed, and each member's /filter
+        passes exactly its assigned node."""
+        with self._lock:
+            self._wire_expire()
+            gspec = pod_gang_spec(pod)
+            if gspec is not None:
+                return self._filter_gang(pod, gspec, node_names)
+            try:
+                req = self._request_for_single(pod)
+            except ValueError as e:
+                return [], {n: f"invalid request: {e}" for n in node_names}
+            quota_reason = self._quota_violation([pod], req)
+            if quota_reason is not None:
+                return [], {n: quota_reason for n in node_names}
+            feasible: list[str] = []
+            reasons: dict[str, str] = {}
+            for name in node_names:
+                st = self._slice_of_node(name)
+                if req.total_chips == 0 and req.millitpu_per_pod == 0:
+                    feasible.append(name)
+                    continue
+                if st is None:
+                    reasons[name] = "node has no TPU advertisement"
+                    continue
+                asg = self.allocator.find_assignment(
+                    [st.restricted_to_node(name)], req)
+                if asg is not None:
+                    feasible.append(name)
+                else:
+                    reasons[name] = \
+                        "insufficient free contiguous chips on node"
+            return feasible, reasons
+
+    def _filter_gang(self, pod: Pod, gspec: GangSpec,
+                     node_names: list[str]
+                     ) -> tuple[list[str], dict[str, str]]:
+        gkey = self._gkey(pod.metadata.namespace, gspec.name)
+        if gkey not in self._wire_assumed:
+            err = self._wire_assume(gkey, pod.metadata.namespace,
+                                    gspec.name)
+            if err is not None:
+                return [], {n: err for n in node_names}
+        entry = self._wire_assumed[gkey].get(pod.name)
+        if entry is None:
+            return [], {n: f"pod not a member of assumed gang "
+                        f"{gspec.name}" for n in node_names}
+        node, _ = entry
+        if node in node_names:
+            return [node], {n: f"gang {gspec.name} is assigned to {node}"
+                            for n in node_names if n != node}
+        return [], {n: f"gang {gspec.name} is assigned to {node}, not "
+                    "offered as a candidate" for n in node_names}
+
+    def prioritize(self, pod: Pod, node_names: list[str]) -> dict[str, float]:
+        """0–10 score per node (extender /prioritize).  Singles are
+        judged against the node's own chips; assumed gang members score
+        10 on their assigned node and 0 elsewhere."""
+        with self._lock:
+            gspec = pod_gang_spec(pod)
+            if gspec is not None:
+                gkey = self._gkey(pod.metadata.namespace, gspec.name)
+                entry = (self._wire_assumed.get(gkey) or {}).get(pod.name)
+                node = entry[0] if entry else None
+                return {n: 10.0 if n == node else 0.0 for n in node_names}
+            try:
+                req = self._request_for_single(pod)
+            except ValueError:
+                return {n: 0.0 for n in node_names}
+            scores: dict[str, float] = {}
+            for name in node_names:
+                st = self._slice_of_node(name)
+                if st is None or (req.total_chips == 0
+                                  and req.millitpu_per_pod == 0):
+                    scores[name] = 5.0 if st is None else 0.0
+                    continue
+                asg = self.allocator.find_assignment(
+                    [st.restricted_to_node(name)], req)
+                scores[name] = asg.score if asg is not None else 0.0
+            return scores
+
+    # ------------------------------------------------------------------
+    # Wire-path bind (extender bindVerb) + gang assumption
+    # ------------------------------------------------------------------
+
+    def bind(self, pod_name: str, node_name: str,
+             namespace: str = "default") -> str | None:
+        """Extender ``bind`` verb — the allocation write-back the
+        reference did at assume/bind time (SURVEY.md §4.2): fill
+        AllocateFrom for the chosen node, PATCH it onto the pod as the
+        allocation annotation, then bind.  Returns an error string (the
+        ExtenderBindingResult.Error payload) or None on success.
+
+        Singles allocate here, atomically under the lock, restricted to
+        the chosen node.  Gang members consume the hold-and-assume
+        decision made at /filter time (see :meth:`_wire_assume`); chips
+        were committed then, so this only writes annotations + binding.
+        """
+        with self._lock:
+            t0 = time.perf_counter()
+            self._wire_expire()
+            from kubegpu_tpu.kubemeta import NotFound
+
+            try:
+                pod = self.api.get("Pod", pod_name, namespace=namespace)
+            except NotFound:
+                return f"pod {namespace}/{pod_name} not found"
+            alloc = pod_allocation(pod)
+            if alloc is not None:
+                # idempotent completion (retry after a half-applied bind)
+                if alloc.node_name != node_name:
+                    return (f"pod already allocated on {alloc.node_name}, "
+                            f"refusing bind to {node_name}")
+                self.api.bind_pod(pod_name, node_name, namespace=namespace)
+                # a gang member retried here still counts toward its
+                # assumption's completion — otherwise the assumption
+                # never fulfills and expiry frees chips this pod OWNS
+                # per its annotation (review r2 finding)
+                gspec = pod_gang_spec(pod)
+                if gspec is not None:
+                    gkey = self._gkey(namespace, gspec.name)
+                    if gkey in self._wire_assumed:
+                        self._wire_note_bound(gkey, pod.name, t0)
+                return None
+            gspec = pod_gang_spec(pod)
+            if gspec is not None:
+                return self._bind_gang_member(pod, gspec, node_name, t0)
+            return self._bind_single(pod, node_name, t0)
+
+    def _bind_single(self, pod: Pod, node_name: str,
+                     t0: float) -> str | None:
+        ns = pod.metadata.namespace
         try:
             req = self._request_for_single(pod)
         except ValueError as e:
-            return [], {n: f"invalid request: {e}" for n in node_names}
-        feasible: list[str] = []
-        reasons: dict[str, str] = {}
-        for name in node_names:
-            st = self._slice_of_node(name)
-            if req.total_chips == 0 and req.millitpu_per_pod == 0:
-                feasible.append(name)
-                continue
-            if st is None:
-                reasons[name] = "node has no TPU advertisement"
-                continue
-            asg = self.allocator.find_assignment(
-                [st.restricted_to_node(name)], req)
-            if asg is not None:
-                feasible.append(name)
-            else:
-                reasons[name] = "insufficient free contiguous chips on node"
-        return feasible, reasons
+            return f"invalid request: {e}"
+        quota_reason = self._quota_violation([pod], req)
+        if quota_reason is not None:
+            self.metrics.inc("schedule_quota_denied")
+            return quota_reason
+        gkey = self._gkey(ns, pod.name)
+        if req.total_chips == 0 and req.millitpu_per_pod == 0:
+            self.api.bind_pod(pod.name, node_name, namespace=ns)
+            self._observe_latency(t0, gkey, scheduled=True)
+            return None
+        st = self._slice_of_node(node_name)
+        if st is None:
+            return f"node {node_name} has no TPU advertisement"
+        asg = self.allocator.find_assignment(
+            [st.restricted_to_node(node_name)], req)
+        if asg is None:
+            self._observe_latency(t0, gkey, scheduled=False)
+            return (f"insufficient free contiguous chips on {node_name}")
+        coordinator, hostnames = GangAllocator.coordinator_for(
+            asg, self.slices, port=self.coordinator_port)
+        allocations = asg.to_allocations(coordinator, hostnames)
+        self.allocator.commit(self.slices, asg)
+        self._committed[gkey] = asg
+        self._gang_priority[gkey] = pod.spec.priority
+        self._gang_migratable[gkey] = pod_migratable(pod)
+        self._pod_gang[gkey] = gkey
+        self.api.patch_annotations(
+            "Pod", pod.name,
+            {ALLOCATE_FROM_KEY: allocation_to_annotation(allocations[0])},
+            namespace=ns)
+        self.api.bind_pod(pod.name, node_name, namespace=ns)
+        self.metrics.observe("allocation_locality", asg.locality)
+        self._observe_latency(t0, gkey, scheduled=True)
+        self.trace.record("bind", gang=gkey, detail={
+            "node": node_name, "locality": asg.locality})
+        return None
 
-    def prioritize(self, pod: Pod, node_names: list[str]) -> dict[str, float]:
-        """0–10 score per node (extender /prioritize), judged against the
-        node's own chips."""
-        try:
-            req = self._request_for_single(pod)
-        except ValueError:
-            return {n: 0.0 for n in node_names}
-        scores: dict[str, float] = {}
-        for name in node_names:
-            st = self._slice_of_node(name)
-            if st is None or (req.total_chips == 0
-                              and req.millitpu_per_pod == 0):
-                scores[name] = 5.0 if st is None else 0.0
+    def _bind_gang_member(self, pod: Pod, gspec: GangSpec,
+                          node_name: str, t0: float) -> str | None:
+        ns = pod.metadata.namespace
+        gkey = self._gkey(ns, gspec.name)
+        if gkey not in self._wire_assumed:
+            err = self._wire_assume(gkey, ns, gspec.name)
+            if err is not None:
+                return err
+        entry = self._wire_assumed[gkey].get(pod.name)
+        if entry is None:
+            return f"pod is not a member of assumed gang {gspec.name}"
+        node, alloc = entry
+        if node != node_name:
+            return (f"gang member is assigned to {node}, refusing bind "
+                    f"to {node_name}")
+        self.api.patch_annotations(
+            "Pod", pod.name,
+            {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc)},
+            namespace=ns)
+        self.api.bind_pod(pod.name, node_name, namespace=ns)
+        self._wire_note_bound(gkey, pod.name, t0)
+        return None
+
+    def _wire_note_bound(self, gkey: str, pod_name: str,
+                         t0: float) -> None:
+        """Record one member's successful bind; on the last one the
+        assumption is fulfilled and forgotten (annotations are now the
+        whole truth)."""
+        bound = self._wire_bound.setdefault(gkey, set())
+        bound.add(pod_name)
+        if bound == set(self._wire_assumed.get(gkey, ())):
+            asg = self._committed.get(gkey)
+            self._wire_assumed.pop(gkey, None)
+            self._wire_assumed_at.pop(gkey, None)
+            self._wire_bound.pop(gkey, None)
+            if asg is not None:
+                self.metrics.observe("allocation_locality", asg.locality)
+            self._observe_latency(t0, gkey, scheduled=True)
+            self.trace.record("bind", gang=gkey, detail={
+                "pods": len(bound), "complete": True})
+
+    def _wire_assume(self, gkey: str, ns: str, bare: str) -> str | None:
+        """Hold-and-assume for a gang arriving over the webhook: when
+        every member exists PENDING in the apiserver, compute one
+        whole-gang assignment against full cluster state, COMMIT its
+        occupancy now (so concurrent decisions see it), and cache each
+        member's (node, Allocation) for its /filter and /bind calls.
+        Returns the failure reason (served as every node's FailedNodes
+        entry — the external scheduler's retry loop is the arrival
+        barrier), or None once assumed."""
+        members: dict[int, Pod] = {}
+        placed = 0
+        size = 0
+        for p in self.api.list("Pod", namespace=ns):
+            gs = pod_gang_spec(p)
+            if gs is None or gs.name != bare:
                 continue
-            asg = self.allocator.find_assignment(
-                [st.restricted_to_node(name)], req)
-            scores[name] = asg.score if asg is not None else 0.0
-        return scores
+            if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            size = gs.size
+            if pod_allocation(p) is not None:
+                placed += 1
+            elif p.status.phase == PodPhase.PENDING:
+                members[gs.index] = p
+        if placed and members:
+            # Half-bound remnant of a LOST assumption (sync()/restart
+            # between a gang's first and last bind): the pending members
+            # can never re-assume (their siblings left PENDING), so the
+            # gang would wedge forever.  Gang atomicity says members
+            # restart together anyway — evict the whole gang; everyone
+            # requeues PENDING and the external scheduler re-runs the
+            # full flow from a clean slate (review r2 finding).
+            self._evict_gang_locked(
+                gang=gkey,
+                reason="partially-bound gang from a lost wire "
+                "assumption; requeued whole for re-scheduling")
+            return (f"gang {bare}: partially-applied assumption was "
+                    "lost; members requeued, retry scheduling")
+        if placed and not members:
+            return f"gang {bare}: already fully bound"
+        if not members:
+            return f"gang {bare}: no pending members visible"
+        if len(members) < size or set(members) != set(range(size)):
+            return f"gang {bare} waiting ({len(members)}/{size})"
+        pods = [members[i] for i in range(size)]
+        try:
+            req = self._request_for_gang(gkey, pods)
+        except ValueError as e:
+            return f"invalid gang request: {e}"
+        quota_reason = self._quota_violation(pods, req)
+        if quota_reason is not None:
+            self.metrics.inc("schedule_quota_denied")
+            return quota_reason
+        asg = self.allocator.find_assignment(
+            list(self.slices.values()), req)
+        if asg is None:
+            return (f"gang {bare}: no contiguous placement for "
+                    f"{req.total_chips} chips")
+        coordinator, hostnames = GangAllocator.coordinator_for(
+            asg, self.slices, port=self.coordinator_port)
+        allocations = asg.to_allocations(coordinator, hostnames)
+        self.allocator.commit(self.slices, asg)
+        self._committed[gkey] = asg
+        self._gang_priority[gkey] = max(p.spec.priority for p in pods)
+        self._gang_migratable[gkey] = all(pod_migratable(p) for p in pods)
+        entry: dict[str, tuple[str, object]] = {}
+        for p, alloc in zip(pods, allocations):
+            alloc.gang_name = bare
+            self._pod_gang[self._gkey(ns, p.name)] = gkey
+            entry[p.name] = (alloc.node_name, alloc)
+        self._wire_assumed[gkey] = entry
+        self._wire_assumed_at[gkey] = time.monotonic()
+        self._wire_bound[gkey] = set()
+        self.trace.record("wire-assume", gang=gkey, detail={
+            "pods": size, "locality": asg.locality,
+            "nodes": sorted({n for n, _ in entry.values()})})
+        return None
+
+    def _wire_expire(self) -> None:
+        """Roll back assumptions the external scheduler abandoned (no
+        bind within the gang grace): release the UNBOUND members' chips,
+        shrink the committed assignment to the bound members (their
+        allocations are annotation truth already), and forget the
+        assumption so the next /filter re-assumes from live state."""
+        now = time.monotonic()
+        stale = [g for g, t in self._wire_assumed_at.items()
+                 if now - t > self.gang_grace_s]
+        for g in stale:
+            entry = self._wire_assumed.pop(g)
+            self._wire_assumed_at.pop(g, None)
+            bound = self._wire_bound.pop(g, set())
+            asg = self._committed.get(g)
+            ns = self._split_gkey(g)[0]
+            for name, (_, alloc) in entry.items():
+                if name in bound:
+                    continue
+                st = self.slices.get(alloc.slice_id)
+                if st is not None:
+                    st.release(alloc.chips)
+                self._pod_gang.pop(self._gkey(ns, name), None)
+            if asg is None:
+                continue
+            if not bound:
+                self._committed.pop(g, None)
+                self._gang_priority.pop(g, None)
+                self._gang_migratable.pop(g, None)
+            else:
+                bound_ids = {entry[n][1].worker_id for n in bound}
+                self._committed[g] = GangAssignment(
+                    slice_id=asg.slice_id,
+                    pods=[p for p in asg.pods
+                          if p.pod_index in bound_ids],
+                    locality=asg.locality, score=asg.score)
+            self.trace.record("wire-expire", gang=g, detail={
+                "bound": len(bound), "assumed": len(entry)})
 
     # ------------------------------------------------------------------
     # Scheduling loop
     # ------------------------------------------------------------------
 
     def run_once(self) -> ScheduleResult:
+        with self._lock:
+            self._wire_expire()
+            return self._run_once_locked()
+
+    def _run_once_locked(self) -> ScheduleResult:
         """One pass over pending pods: group into gangs, place complete
         gangs atomically, write allocation annotations, bind.
 
@@ -327,6 +650,12 @@ class DeviceScheduler:
         barrier: str | None = None  # incomplete gang blocking later units
         protected: list[GangRequest] = []  # held units' asks, queue order
         for kind, unit in units:
+            if kind == "gang" and unit in self._wire_assumed:
+                # mid-bind by an external scheduler over the webhook —
+                # chips are already committed; don't double-place
+                result.held.extend(
+                    p.name for p in gangs[unit].pods.values())
+                continue
             if kind == "gang" and not gangs[unit].complete():
                 gname, pg = unit, gangs[unit]
                 result.held.extend(p.name for p in pg.pods.values())
@@ -684,20 +1013,25 @@ class DeviceScheduler:
         """Namespace is REQUIRED: pod identity is namespace-qualified,
         and a defaulted wrong namespace would silently no-op and leak the
         gang's chips until the next full sync."""
-        gang = self._pod_gang.pop(self._gkey(namespace, pod_name), None)
-        if gang is None:
-            return
-        # release only when the last member of the gang is gone
-        if any(g == gang for g in self._pod_gang.values()):
-            return
-        self._gang_priority.pop(gang, None)
-        self._gang_migratable.pop(gang, None)
-        asg = self._committed.pop(gang, None)
-        if asg is not None:
-            # rollback skips slices that vanished (multislice: free the rest)
-            self.allocator.rollback(self.slices, asg)
-            self.trace.record("release", gang=gang,
-                              detail={"slices": asg.slice_ids})
+        with self._lock:
+            gang = self._pod_gang.pop(self._gkey(namespace, pod_name),
+                                      None)
+            if gang is None:
+                return
+            # release only when the last member of the gang is gone
+            if any(g == gang for g in self._pod_gang.values()):
+                return
+            self._gang_priority.pop(gang, None)
+            self._gang_migratable.pop(gang, None)
+            self._wire_assumed.pop(gang, None)
+            self._wire_assumed_at.pop(gang, None)
+            self._wire_bound.pop(gang, None)
+            asg = self._committed.pop(gang, None)
+            if asg is not None:
+                # rollback skips vanished slices (multislice: free the rest)
+                self.allocator.rollback(self.slices, asg)
+                self.trace.record("release", gang=gang,
+                                  detail={"slices": asg.slice_ids})
 
     # ------------------------------------------------------------------
     # Preemption + eviction (shared with the fault-recovery controller)
@@ -958,6 +1292,10 @@ class DeviceScheduler:
         return-resources path), then recreate identical PENDING pods —
         same name/spec/gang, no binding, no allocation annotation — so the
         next pass schedules the gang fresh.  Returns requeued pod names."""
+        with self._lock:
+            return self._evict_gang_locked(gang, reason)
+
+    def _evict_gang_locked(self, gang: str, reason: str) -> list[str]:
         from kubegpu_tpu.kubemeta import NotFound
         from kubegpu_tpu.kubemeta.objects import ObjectMeta, PodStatus
 

@@ -2,11 +2,12 @@
 
 The reference's device-scheduler is an HTTP webhook kube-scheduler calls
 per pod via its policy config (SURVEY.md §3 "Scheduler extender service":
-``/filter`` predicate, ``/prioritize`` 0-10 scores; §6 config row:
-``extenders: [{urlPrefix, filterVerb, prioritizeVerb, weight}]``).  This
-module serves the same API over the in-process :class:`DeviceScheduler`,
-speaking the k8s ``ExtenderArgs``/``ExtenderFilterResult`` JSON shapes,
-so a real kube-scheduler pointed at it would work unmodified.
+``/filter`` predicate, ``/prioritize`` 0-10 scores, bind-time allocation
+write-back per §4.2; §6 config row: ``extenders: [{urlPrefix,
+filterVerb, prioritizeVerb, bindVerb, weight}]``).  This module serves
+that API over the in-process :class:`DeviceScheduler`, speaking the k8s
+``ExtenderArgs``/``ExtenderFilterResult``/``ExtenderBindingArgs`` JSON
+shapes.
 
 Request/response wire format (k8s.io/kubernetes/pkg/scheduler/api):
 
@@ -14,10 +15,28 @@ Request/response wire format (k8s.io/kubernetes/pkg/scheduler/api):
       → {"NodeNames": [...], "FailedNodes": {node: reason}, "Error": ""}
     POST <prefix>/prioritize  {"Pod": {...}, "NodeNames": [...]}
       → [{"Host": node, "Score": 0-10}, ...]   (HostPriorityList)
+    POST <prefix>/bind        {"PodName", "PodNamespace", "PodUID", "Node"}
+      → {"Error": ""}        (fills AllocateFrom + PATCHes the pod
+                              annotation + binds — SURVEY.md §4.2)
 
 The Pod document carries the same fields the annotation codec uses
 (metadata.annotations for gang/mesh-axes/multislice, spec container
 resources) — :func:`pod_from_doc` rebuilds the internal Pod.
+
+What the wire verbs guarantee vs the in-process loop
+----------------------------------------------------
+A real kube-scheduler driving filter→prioritize→bind gets: per-node
+feasibility/scoring, bind-time allocation write-back, namespace quota
+gating, and GANG atomicity via hold-and-assume (all members' /filter
+fail with "gang waiting (k/n)" until the gang is complete — the
+scheduler's retry loop is the arrival barrier, as in the coscheduling
+plugin — then one whole-gang placement steers every member).  What needs
+the in-process ``run_once()`` loop instead: cross-gang FIFO fairness +
+queue-seniority, priority preemption, conservative backfill, migration
+defragmentation, and fault-driven eviction (a vanilla kube-scheduler
+owns preemption itself and offers the extender no hook).  An abandoned
+wire assumption (members never bound) expires after the gang grace and
+its unbound chips are released.
 """
 
 from __future__ import annotations
@@ -114,6 +133,14 @@ class ExtenderService:
         return [{"Host": n, "Score": int(round(scores.get(n, 0.0)))}
                 for n in node_names]
 
+    def bind(self, args: dict) -> dict:
+        """ExtenderBindingArgs → ExtenderBindingResult."""
+        err = self.scheduler.bind(
+            str(args.get("PodName") or ""),
+            str(args.get("Node") or ""),
+            namespace=str(args.get("PodNamespace") or "default"))
+        return {"Error": err or ""}
+
 
 class ExtenderHTTPServer:
     """ThreadingHTTPServer wrapper: start() binds and serves in a daemon
@@ -137,6 +164,8 @@ class ExtenderHTTPServer:
                         out = service.filter(args)
                     elif self.path == f"{prefix}/prioritize":
                         out = service.prioritize(args)
+                    elif self.path == f"{prefix}/bind":
+                        out = service.bind(args)
                     else:
                         self.send_error(404, f"unknown verb {self.path}")
                         return
@@ -146,6 +175,8 @@ class ExtenderHTTPServer:
                         # filter's contract carries an Error field
                         out = {"NodeNames": [], "FailedNodes": {},
                                "Error": str(e)}
+                    elif self.path == f"{prefix}/bind":
+                        out = {"Error": str(e)}
                     else:
                         # prioritize's contract is a bare HostPriorityList
                         # (no Error slot) — signal failure at HTTP level
@@ -191,6 +222,7 @@ def policy_config(extender_url: str, weight: int = 10) -> dict:
             "urlPrefix": f"{extender_url}/kubetpu",
             "filterVerb": "filter",
             "prioritizeVerb": "prioritize",
+            "bindVerb": "bind",
             "weight": weight,
             "enableHttps": False,
             # nodeCacheCapable=true ⇒ kube-scheduler sends/accepts

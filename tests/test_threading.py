@@ -103,3 +103,75 @@ def test_scheduler_loop_vs_churn_and_faults():
         for coord, used in st.used_millichips.items():
             assert 0 <= used <= MILLICHIPS_PER_CHIP, (coord, used)
     cl.close()
+
+
+def test_webhook_bind_vs_pod_churn_no_deadlock():
+    """Review r2 regression (ABBA deadlock): webhook threads hold the
+    scheduler lock and call into the apiserver, while apiserver watch
+    callbacks call back into the scheduler.  Delivery outside the
+    apiserver lock must keep these from deadlocking."""
+    import threading
+
+    from kubegpu_tpu.cluster import SimCluster, tpu_pod
+    from kubegpu_tpu.kubemeta import Conflict, NotFound
+
+    cl = SimCluster(["v5e-16"])
+    stop = threading.Event()
+    errors = []
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:   # pragma: no cover - failure path
+                errors.append(e)
+                stop.set()
+        return run
+
+    def binder():
+        # hammer the wire verbs: filter + bind of short-lived singles
+        i = 0
+        while not stop.is_set() and i < 200:
+            name = f"wire-{i}"
+            i += 1
+            try:
+                cl.api.create("Pod", tpu_pod(name, chips=1,
+                                             command=["x"]))
+            except Conflict:
+                continue
+            nodes = [n.name for n in cl.api.list("Node")]
+            pod = cl.api.get("Pod", name)
+            feasible, _ = cl.scheduler.filter(pod, nodes)
+            if feasible:
+                cl.scheduler.bind(name, feasible[0])
+            try:
+                cl.api.delete("Pod", name)   # fires watch → release
+            except NotFound:
+                pass
+
+    def churner():
+        # create/delete pods from another thread: every delete delivers
+        # a watch event that re-enters the scheduler
+        i = 0
+        while not stop.is_set() and i < 200:
+            name = f"churn-{i}"
+            i += 1
+            try:
+                cl.api.create("Pod", tpu_pod(name, chips=1,
+                                             command=["x"]))
+                cl.scheduler.run_once()
+                cl.api.delete("Pod", name)
+            except (Conflict, NotFound):
+                pass
+
+    threads = [threading.Thread(target=guard(f), daemon=True)
+               for f in (binder, churner)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    alive = [t for t in threads if t.is_alive()]
+    stop.set()
+    assert not alive, "deadlock: threads still blocked after 60s"
+    assert not errors, errors[0]
+    cl.close()
