@@ -1,16 +1,40 @@
 """Real-hardware backend: enumerate chips via the JAX TPU client.
 
-On a real TPU VM, ``jax.devices()`` exposes per-device ``.coords`` (ICI mesh
-coordinate) and ``.process_index`` — the libtpu-backed equivalent of the
-reference's NVML enumeration (SURVEY.md §3 ``NvidiaGPUManager``).  Falls
-back to a degenerate single-chip advertisement when coords are unavailable
-(e.g. the axon tunnel exposes one chip).
+On a real TPU VM, ``jax.local_devices()`` exposes per-device ``.coords``
+(global ICI mesh coordinate) and ``.process_index`` — the libtpu-backed
+equivalent of the reference's NVML enumeration (SURVEY.md §3
+``NvidiaGPUManager``).  Three discovery modes, most-informed first:
+
+1. **Registry slice** — when ``TPU_ACCELERATOR_TYPE`` (set on Cloud TPU
+   VMs / injected by the crishim) names a known topology
+   (``v5litepod-16`` → ``v5e-16``), the advertisement describes this
+   host as ONE HOST OF THAT SLICE: global mesh shape/wrap/host_block
+   from the registry, ``host_id`` from ``TPU_WORKER_ID`` (fallback:
+   ``process_index``), and the local chips' coords VALIDATED against
+   the host_block tiling for that host id — a mismatched worker id
+   would silently corrupt TPU_WORKER_ID ordering downstream (SURVEY.md
+   §8 "Worker identity wiring"), so it raises instead.  N hosts
+   advertising this way assemble into the full slice via
+   ``SliceState.from_advertisements``.
+2. **Local standalone** — no recognized type: the host's chips form
+   their own slice (coords normalized to origin), which is exactly what
+   the single-chip axon tunnel and CPU test environments look like.
+3. Raise when no TPU devices are visible at all.
+
+Health is first-class (the reference's NVML path reported per-device
+health; SURVEY.md §6 failure-detection row): a pluggable
+``health_check`` callable vets each chip at discovery time, and
+``mark_chip_unhealthy`` / ``report_bad_link`` let node-local monitors
+(ECC scrubbers, link flap counters) feed faults into the next
+advertisement tick.
 """
 
 from __future__ import annotations
 
 import os
+import re
 
+from kubegpu_tpu.topology.mesh import TOPOLOGY_REGISTRY, TopologySpec, TpuTopology
 from kubegpu_tpu.tpuplugin.backend import (
     MILLICHIPS_PER_CHIP,
     ChipAdvertisement,
@@ -19,14 +43,93 @@ from kubegpu_tpu.tpuplugin.backend import (
 )
 from kubegpu_tpu.tpuplugin.mock import build_tpu_env
 
+_DEFAULT_HBM_GIB = 16.0
+
+# Cloud TPU accelerator-type strings → registry slice types.
+_ACCEL_RE = re.compile(r"^(v\d+[a-z]*(?:litepod|pod)?)-(\d+)$")
+_GEN_MAP = {"v5litepod": "v5e", "v5e": "v5e", "v4": "v4",
+            "v5p": "v5p", "v5pod": "v5p"}
+
+
+def slice_type_from_accelerator(accel_type: str | None) -> str | None:
+    """``TPU_ACCELERATOR_TYPE`` → registry key, or None when unknown.
+
+    v4/v5p accelerator-type counts are TensorCores (2/chip); v5e counts
+    are chips.  The registry names follow the same convention
+    (``v4-8`` = 4 chips), so the count passes through unchanged.
+    """
+    if not accel_type:
+        return None
+    m = _ACCEL_RE.match(accel_type.strip())
+    if not m:
+        return None
+    gen = _GEN_MAP.get(m.group(1))
+    if gen is None:
+        return None
+    name = f"{gen}-{m.group(2)}"
+    return name if name in TOPOLOGY_REGISTRY else None
+
 
 class LibtpuBackend(DeviceBackend):
     """Discover this host's real TPU chips through JAX."""
 
-    def __init__(self, slice_id: str = "local-slice",
-                 node_name: str | None = None):
-        self.slice_id = slice_id
+    def __init__(self, slice_id: str | None = None,
+                 node_name: str | None = None,
+                 health_check=None):
+        self.slice_id = slice_id or os.environ.get(
+            "KUBETPU_SLICE_ID", "local-slice")
         self.node_name = node_name or os.environ.get("HOSTNAME", "local-node")
+        # health_check(local_chip_index, device) -> bool; None = healthy
+        self.health_check = health_check
+        self.unhealthy_chips: set[int] = set()
+        self.bad_links: set[tuple] = set()  # normalized coord pairs
+
+    # -- fault hooks (node-local monitors feed these; the advertiser's
+    #    next tick picks them up, mirroring MockBackend's test hooks) ----
+
+    def mark_chip_unhealthy(self, local_index: int) -> None:
+        self.unhealthy_chips.add(local_index)
+
+    def heal_chip(self, local_index: int) -> None:
+        self.unhealthy_chips.discard(local_index)
+
+    def report_bad_link(self, a, b) -> None:
+        a, b = tuple(a), tuple(b)
+        self.bad_links.add((min(a, b), max(a, b)))
+
+    def heal_link(self, a, b) -> None:
+        a, b = tuple(a), tuple(b)
+        self.bad_links.discard((min(a, b), max(a, b)))
+
+    # -- discovery -------------------------------------------------------
+
+    @staticmethod
+    def _local_chips(tpus) -> list[tuple[tuple, object]]:
+        """Deduplicated (3D coord, device) per PHYSICAL CHIP, in device
+        order.  Megacore generations expose 2 cores per chip sharing one
+        coord — TPU_VISIBLE_CHIPS indexes chips, not cores, so the
+        local_index MUST count deduped chips."""
+        out: list[tuple[tuple, object]] = []
+        seen: set[tuple] = set()
+        for li, d in enumerate(tpus):
+            coord = tuple(getattr(d, "coords", (li, 0, 0)))
+            if len(coord) == 2:          # 2D generations: z = 0
+                coord = (coord[0], coord[1], 0)
+            if coord in seen:
+                continue
+            seen.add(coord)
+            out.append((coord, d))
+        return out
+
+    @staticmethod
+    def _hbm_gib(device) -> float:
+        try:
+            stats = device.memory_stats()
+            if stats and "bytes_limit" in stats:
+                return stats["bytes_limit"] / (1 << 30)
+        except Exception:
+            pass
+        return _DEFAULT_HBM_GIB
 
     def discover(self) -> NodeAdvertisement:
         import jax  # deferred: control-plane processes must not init TPU
@@ -35,38 +138,100 @@ class LibtpuBackend(DeviceBackend):
         tpus = [d for d in local if d.platform.startswith(("tpu", "axon"))]
         if not tpus:
             raise RuntimeError("LibtpuBackend: no TPU devices visible")
+        chip_devs = self._local_chips(tpus)
+        spec = self._registry_spec()
+        if spec is not None:
+            return self._discover_registry(spec, chip_devs, tpus)
+        return self._discover_local(chip_devs, tpus)
+
+    @staticmethod
+    def _registry_spec() -> TopologySpec | None:
+        name = slice_type_from_accelerator(
+            os.environ.get("TPU_ACCELERATOR_TYPE"))
+        return TOPOLOGY_REGISTRY.get(name) if name else None
+
+    def _discover_registry(self, spec: TopologySpec, chip_devs,
+                           tpus) -> NodeAdvertisement:
+        """One host of a known multi-host slice: validate this host's
+        chips against the host_block tiling for its worker id."""
+        topo = TpuTopology.build(spec)
+        host_id = int(os.environ.get(
+            "TPU_WORKER_ID", getattr(tpus[0], "process_index", 0)))
+        if not 0 <= host_id < spec.num_hosts:
+            raise ValueError(
+                f"LibtpuBackend: worker id {host_id} out of range for "
+                f"{spec.name} ({spec.num_hosts} hosts)")
+        expected = {topo.chips[i].coord
+                    for i in topo.hosts[host_id].chip_indices}
+        got = {c for c, _ in chip_devs}
+        if got != expected:
+            raise ValueError(
+                f"LibtpuBackend: host {host_id} of {spec.name} should own "
+                f"chips {sorted(expected)} per the host_block tiling, but "
+                f"jax reports {sorted(got)} — a mismatched TPU_WORKER_ID "
+                "here would corrupt worker ordering, refusing to "
+                "advertise")
+        # every host of the slice must advertise the SAME slice_id for
+        # SliceState.from_advertisements to assemble them; operators set
+        # KUBETPU_SLICE_ID, and the default derives from the slice type
+        # so same-typed hosts agree without configuration
+        slice_id = (self.slice_id if self.slice_id != "local-slice"
+                    else f"{spec.name}-slice")
+        return self._advertisement(
+            slice_type=spec.name,
+            host_id=host_id,
+            mesh_shape=spec.mesh_shape,
+            wrap=spec.wrap,
+            host_block=spec.host_block,
+            chip_devs=chip_devs,
+            slice_id=slice_id)
+
+    def _discover_local(self, chip_devs, tpus) -> NodeAdvertisement:
+        """Standalone single-host slice (axon tunnel, dev VM): the local
+        chips ARE the mesh, coords normalized to origin."""
+        coords = [c for c, _ in chip_devs]
+        mins = tuple(min(c[i] for c in coords) for i in range(3))
+        chip_devs = [(tuple(c[i] - mins[i] for i in range(3)), d)
+                     for c, d in chip_devs]
+        shape = tuple(max(c[i] for c, _ in chip_devs) + 1 for i in range(3))
+        return self._advertisement(
+            slice_type=f"local-{len(chip_devs)}chip",
+            host_id=int(getattr(tpus[0], "process_index", 0)),
+            mesh_shape=shape,
+            wrap=(False, False, False),
+            host_block=shape,
+            chip_devs=chip_devs,
+            slice_id=self.slice_id)
+
+    def _advertisement(self, slice_type, host_id, mesh_shape, wrap,
+                       host_block, chip_devs, slice_id) -> NodeAdvertisement:
+        local_coords = set()
         chips = []
-        coords_seen = set()
-        for li, d in enumerate(tpus):
-            coord = tuple(getattr(d, "coords", (li, 0, 0)))
-            if len(coord) == 2:
-                coord = (coord[0], coord[1], 0)
-            if coord in coords_seen:  # megacore: 2 cores, 1 chip
-                continue
-            coords_seen.add(coord)
-            hbm = 16.0
-            try:
-                stats = d.memory_stats()
-                if stats and "bytes_limit" in stats:
-                    hbm = stats["bytes_limit"] / (1 << 30)
-            except Exception:
-                pass
+        for li, (coord, dev) in enumerate(chip_devs):
+            healthy = li not in self.unhealthy_chips
+            if healthy and self.health_check is not None:
+                healthy = bool(self.health_check(li, dev))
+            local_coords.add(coord)
             chips.append(ChipAdvertisement(
                 coord=coord, local_index=li,
-                millichips=MILLICHIPS_PER_CHIP, hbm_gib=hbm))
-        xs = [c.coord[0] for c in chips]
-        ys = [c.coord[1] for c in chips]
-        zs = [c.coord[2] for c in chips]
-        mesh_shape = (max(xs) + 1, max(ys) + 1, max(zs) + 1)
+                millichips=MILLICHIPS_PER_CHIP,
+                hbm_gib=self._hbm_gib(dev),
+                healthy=healthy))
+        # advertise only links incident to a local chip (each host owns
+        # its own faults; the scheduler unions per slice)
+        incident = tuple(sorted(
+            (a, b) for a, b in self.bad_links
+            if a in local_coords or b in local_coords))
         return NodeAdvertisement(
             node_name=self.node_name,
-            slice_id=self.slice_id,
-            slice_type=f"local-{len(chips)}chip",
-            host_id=getattr(tpus[0], "process_index", 0),
-            mesh_shape=mesh_shape,
-            wrap=(False, False, False),
-            host_block=mesh_shape,
+            slice_id=slice_id,
+            slice_type=slice_type,
+            host_id=host_id,
+            mesh_shape=tuple(mesh_shape),
+            wrap=tuple(wrap),
+            host_block=tuple(host_block),
             chips=tuple(chips),
+            bad_links=incident,
         )
 
     def allocate_env(self, chips, worker_id, num_workers,
