@@ -13,6 +13,6 @@ import sys
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from kubegpu_tpu.benchmark import run_bench
+    from kubegpu_tpu.benchmark import run_full_bench
     n = int(os.environ.get("BENCH_GANGS", "60"))
-    print(json.dumps(run_bench(n_gangs=n)))
+    print(json.dumps(run_full_bench(n_gangs=n)))

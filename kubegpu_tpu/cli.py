@@ -244,8 +244,12 @@ def cmd_demo(args) -> int:
 
 
 def cmd_bench(args) -> int:
-    from kubegpu_tpu.benchmark import run_bench
-    print(json.dumps(run_bench(n_gangs=args.gangs, seed=args.seed)))
+    from kubegpu_tpu.benchmark import run_bench, run_full_bench
+    if args.model:
+        out = run_full_bench(n_gangs=args.gangs, seed=args.seed)
+    else:   # scheduler half only — fast, no accelerator involvement
+        out = run_bench(n_gangs=args.gangs, seed=args.seed)
+    print(json.dumps(out))
     return 0
 
 
@@ -317,6 +321,10 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("bench", help="gang-schedule latency benchmark")
     p.add_argument("--gangs", type=int, default=60)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model", action="store_true",
+                   help="also run the hardware model bench (MFU, "
+                   "tokens/s, pallas-vs-XLA attention) on the default "
+                   "accelerator; results land under details.model")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser("slices", help="list known TPU slice types")

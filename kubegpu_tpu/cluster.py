@@ -92,7 +92,8 @@ class SimCluster:
             self.runtime = SubprocessRuntime(extra_env=merged_env)
         else:
             self.runtime = FakeRuntime()
-        self.agents = [NodeAgent(self.api, b, self.runtime)
+        self.agents = [NodeAgent(self.api, b, self.runtime,
+                                 metrics=self.metrics)
                        for b in mock_cluster(slice_types)]
         for a in self.agents:
             a.register()

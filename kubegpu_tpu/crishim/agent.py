@@ -7,6 +7,9 @@ node and calling the CRI).  One agent per (simulated) TPU host VM.
 
 from __future__ import annotations
 
+import json
+import math
+
 from kubegpu_tpu.crishim.runtime import ContainerHandle, ContainerRuntime
 from kubegpu_tpu.crishim.shim import CriShim
 from kubegpu_tpu.kubemeta import (
@@ -20,17 +23,49 @@ from kubegpu_tpu.kubemeta.codec import (
     DEVICE_INFO_KEY,
     node_advertisement_to_annotation,
 )
+from kubegpu_tpu.obs import MetricsRegistry
 from kubegpu_tpu.tpuplugin.backend import DeviceBackend
+
+
+def harvest_workload_metrics(stdout: str, metrics: MetricsRegistry,
+                             pod_name: str = "") -> list[str]:
+    """Scan a finished container's stdout for metric lines — any line
+    that parses as JSON with numeric ``metric``/``value`` fields (the
+    convention the workload programs print, e.g. the allreduce
+    microbenchmark's ``allreduce_algo_bandwidth``) — and feed them into
+    the cluster metrics registry as ``workload_<metric>`` observations
+    + gauges.  This is how north-star metric #2 lands in
+    ``metrics.snapshot()`` instead of dying in a process log."""
+    seen: list[str] = []
+    for line in stdout.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            doc = json.loads(line)
+            name = str(doc["metric"])
+            value = float(doc["value"])
+        except (ValueError, KeyError, TypeError):
+            continue
+        if not math.isfinite(value):
+            continue   # a NaN would poison the whole histogram
+
+        metrics.observe(f"workload_{name}", value)
+        metrics.set_gauge(f"workload_{name}", value)
+        seen.append(name)
+    return seen
 
 
 class NodeAgent:
     def __init__(self, api: FakeApiServer, backend: DeviceBackend,
-                 runtime: ContainerRuntime):
+                 runtime: ContainerRuntime,
+                 metrics: MetricsRegistry | None = None):
         self.api = api
         self.backend = backend
         self.adv = backend.discover()
         self.node_name = self.adv.node_name
         self.runtime = runtime
+        self.metrics = metrics
         self.shim = CriShim(api, backend, self.node_name, runtime)
         self.handles: dict[str, ContainerHandle] = {}  # pod name → handle
         self._uids: dict[str, str] = {}  # pod name → uid of the incarnation
@@ -137,6 +172,9 @@ class NodeAgent:
             results[pod_name] = code
             phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
             ns = self._ns.get(pod_name, "default")
+            if code == 0 and self.metrics is not None:
+                harvest_workload_metrics(handle.stdout, self.metrics,
+                                         pod_name=pod_name)
             try:
                 # only report for the incarnation this container belongs to
                 self.api.set_pod_phase(

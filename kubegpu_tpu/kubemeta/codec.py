@@ -30,6 +30,11 @@ MIGRATABLE_KEY = "pod.alpha.kubetpu/migratable"
 # equal-priority pending unit could steal the home a migration plan
 # proved for it
 QUEUED_AT_KEY = "pod.alpha.kubetpu/queued-at"
+# a MIGRATED gang's reserved re-ask (serialized GangRequest): persisted
+# on the requeued pods so a scheduler restart between migration-eviction
+# and re-placement cannot drop the what-if home protection (annotation
+# truth, like everything else); cleared when the gang re-places
+MIGRATION_DEBT_KEY = "pod.alpha.kubetpu/migration-debt"
 
 
 # ---------------------------------------------------------------------------

@@ -167,11 +167,12 @@ class FakeApiServer:
         return out
 
     def patch_annotations(self, kind: str, name: str,
-                          annotations: dict[str, str],
+                          annotations: dict[str, str | None],
                           namespace: str = "default"):
         """Strategic-merge patch of annotations only — the reference's
         ``client-go Patch`` path used by the advertiser and the allocation
-        write-back (SURVEY.md §4.1/§4.2).  Never conflicts.
+        write-back (SURVEY.md §4.1/§4.2).  Never conflicts.  A ``None``
+        value DELETES the key (k8s strategic-merge null semantics).
         """
         with self._lock:
             store = self._stores[kind]
@@ -179,7 +180,11 @@ class FakeApiServer:
             if key not in store.objects:
                 raise NotFound(f"{kind} {key}")
             obj = store.objects[key]
-            obj.metadata.annotations.update(annotations)
+            for k, v in annotations.items():
+                if v is None:
+                    obj.metadata.annotations.pop(k, None)
+                else:
+                    obj.metadata.annotations[k] = v
             self._bump(obj)
             self._notify(WatchEvent(kind, "MODIFIED", obj.clone()))
             out = obj.clone()

@@ -48,6 +48,7 @@ from kubegpu_tpu.kubemeta import (
 )
 from kubegpu_tpu.kubemeta.codec import (
     ALLOCATE_FROM_KEY,
+    MIGRATION_DEBT_KEY,
     allocation_to_annotation,
     node_advertisement,
 )
@@ -145,6 +146,38 @@ class DeviceScheduler:
         return ns, bare
 
     @staticmethod
+    def _debt_to_annotation(req: GangRequest) -> str:
+        import json
+
+        return json.dumps({
+            "numPods": req.num_pods,
+            "chipsPerPod": req.chips_per_pod,
+            "millitpuPerPod": req.millitpu_per_pod,
+            "hbmGibPerChip": req.hbm_gib_per_chip,
+            "meshAxes": (list(req.mesh_axes.items())
+                         if req.mesh_axes else None),
+            "allowMultislice": req.allow_multislice,
+        }, sort_keys=True)
+
+    @staticmethod
+    def _debt_from_annotation(gkey: str, payload: str) -> GangRequest | None:
+        import json
+
+        try:
+            d = json.loads(payload)
+            return GangRequest(
+                gang_name=gkey,
+                num_pods=int(d["numPods"]),
+                chips_per_pod=int(d["chipsPerPod"]),
+                millitpu_per_pod=int(d.get("millitpuPerPod", 0)),
+                hbm_gib_per_chip=float(d.get("hbmGibPerChip", 0.0)),
+                mesh_axes=dict((k, int(v)) for k, v in d["meshAxes"])
+                if d.get("meshAxes") else None,
+                allow_multislice=bool(d.get("allowMultislice", False)))
+        except (ValueError, KeyError, TypeError):
+            return None   # malformed debt: drop the reservation, not the pod
+
+    @staticmethod
     def _arrival(pod: Pod) -> int:
         """Queue position: the original arrival for requeued pods."""
         from kubegpu_tpu.kubemeta.codec import QUEUED_AT_KEY
@@ -208,6 +241,23 @@ class DeviceScheduler:
         # recovery controller must still see them to evict/requeue, else
         # they'd zombie as RUNNING pods bound to dead nodes.  Slice ids are
         # per-pod (a multislice gang spans several).
+        # migration debts rebuild from annotation truth too: PENDING
+        # requeued pods carry the serialized reservation, so a restart
+        # between migration-eviction and re-placement keeps the mover's
+        # proven home protected (advisor r1 finding)
+        self._migration_debts.clear()
+        for pod in self.api.list("Pod", phase=PodPhase.PENDING):
+            payload = pod.metadata.annotations.get(MIGRATION_DEBT_KEY)
+            if not payload:
+                continue
+            gs = pod_gang_spec(pod)
+            gkey = self._gkey(pod.metadata.namespace,
+                              gs.name if gs else pod.name)
+            if gkey in self._migration_debts:
+                continue   # every member carries the same debt
+            req = self._debt_from_annotation(gkey, payload)
+            if req is not None:
+                self._migration_debts[gkey] = req
         for gang, allocs in gang_pods.items():
             pods = []
             for a in sorted(allocs, key=lambda a: a.worker_id):
@@ -408,7 +458,8 @@ class DeviceScheduler:
         self._pod_gang[gkey] = gkey
         self.api.patch_annotations(
             "Pod", pod.name,
-            {ALLOCATE_FROM_KEY: allocation_to_annotation(allocations[0])},
+            {ALLOCATE_FROM_KEY: allocation_to_annotation(allocations[0]),
+             MIGRATION_DEBT_KEY: None},   # repaid via the wire path too
             namespace=ns)
         self.api.bind_pod(pod.name, node_name, namespace=ns)
         self.metrics.observe("allocation_locality", asg.locality)
@@ -434,7 +485,8 @@ class DeviceScheduler:
                     f"to {node_name}")
         self.api.patch_annotations(
             "Pod", pod.name,
-            {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc)},
+            {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc),
+             MIGRATION_DEBT_KEY: None},   # repaid via the wire path too
             namespace=ns)
         self.api.bind_pod(pod.name, node_name, namespace=ns)
         self._wire_note_bound(gkey, pod.name, t0)
@@ -948,11 +1000,25 @@ class DeviceScheduler:
                     # (the request needs the still-committed assignment)
                     vreq = self._request_for_committed(victim)
                     self.metrics.inc("gangs_migrated")
-                    self.evict_gang(
+                    requeued = self.evict_gang(
                         victim,
                         f"migrated to defragment for {gang_name}")
                     if vreq is not None:
                         self._migration_debts[victim] = vreq
+                        # persist on the requeued pods: a scheduler
+                        # restart must not drop the home reservation
+                        # (annotation truth — advisor r1 finding)
+                        vns = self._split_gkey(victim)[0]
+                        payload = self._debt_to_annotation(vreq)
+                        from kubegpu_tpu.kubemeta import NotFound
+                        for pname in requeued:
+                            try:
+                                self.api.patch_annotations(
+                                    "Pod", pname,
+                                    {MIGRATION_DEBT_KEY: payload},
+                                    namespace=vns)
+                            except NotFound:
+                                pass
                 asg = self.allocator.find_assignment(
                     list(self.slices.values()), req)
         if asg is None:
@@ -984,7 +1050,9 @@ class DeviceScheduler:
                                       pod.name)] = gang_name
             self.api.patch_annotations(
                 "Pod", pod.name,
-                {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc)},
+                {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc),
+                 # debt repaid: drop the persisted home reservation
+                 MIGRATION_DEBT_KEY: None},
                 namespace=pod.metadata.namespace)
             self.api.bind_pod(pod.name, alloc.node_name,
                               namespace=pod.metadata.namespace)

@@ -175,3 +175,24 @@ class TestRealProcessPath:
             assert codes.get("mnist-cpu") == 0
         finally:
             cl.close()
+
+
+class TestWorkloadMetricsHarvest:
+    def test_harvest_parses_metric_lines(self):
+        from kubegpu_tpu.crishim.agent import harvest_workload_metrics
+        from kubegpu_tpu.obs import MetricsRegistry
+
+        m = MetricsRegistry()
+        stdout = (
+            "some log line\n"
+            '{"metric": "allreduce_algo_bandwidth", "value": 12.5, '
+            '"unit": "GiB/s", "devices": 4}\n'
+            '{"not": "a metric"}\n'
+            '{"metric": "bad", "value": "NaN-ish-string"}\n'
+            "trailing text\n")
+        seen = harvest_workload_metrics(stdout, m)
+        assert seen == ["allreduce_algo_bandwidth"]
+        snap = m.snapshot()
+        assert snap["gauges"]["workload_allreduce_algo_bandwidth"] == 12.5
+        h = snap["histograms"]["workload_allreduce_algo_bandwidth"]
+        assert h["count"] == 1

@@ -144,3 +144,41 @@ class TestMigration:
         assert moved[0] in result.scheduled
         assert cl.pod_phase("rival") == PodPhase.PENDING
         cl.close()
+
+
+class TestMigrationDebtPersistence:
+    def test_debt_survives_scheduler_restart(self):
+        """Advisor r1 regression: a scheduler restart between
+        migration-eviction and re-placement must not drop the mover's
+        home reservation — the debt persists as a pod annotation and
+        rebuilds in sync(), so an equal-priority backfiller submitted
+        after the restart cannot take the freed block."""
+        cl = SimCluster(["v5e-16"])
+        survivors = TestMigration()._fragment_v5e16(cl)
+        cl.submit(*[
+            tpu_pod(f"big-{i}", chips=4,
+                    gang=GangSpec(name="big", size=2, index=i),
+                    command=["x"])
+            for i in range(2)
+        ])
+        result, _ = cl.step()
+        assert {"big-0", "big-1"} <= set(result.scheduled)
+        moved = [n for n in survivors
+                 if cl.pod_phase(n) == PodPhase.PENDING]
+        assert len(moved) == 1
+        # restart: rebuild ALL scheduler state from annotation truth
+        assert cl.scheduler._migration_debts   # in-memory before
+        cl.scheduler.sync()
+        assert list(cl.scheduler._migration_debts) == [
+            f"default/{moved[0]}"]
+        # an equal-priority 4-chip single arrives AFTER the restart; the
+        # mover still wins its reserved home (queue seniority + debt)
+        cl.submit(tpu_pod("thief", chips=4, command=["x"]))
+        result, _ = cl.step()
+        assert moved[0] in result.scheduled
+        # debt repaid: annotation cleared, registry empty
+        assert not cl.scheduler._migration_debts
+        pod = cl.api.get("Pod", moved[0])
+        from kubegpu_tpu.kubemeta.codec import MIGRATION_DEBT_KEY
+        assert MIGRATION_DEBT_KEY not in pod.metadata.annotations
+        cl.close()

@@ -47,6 +47,12 @@ class TestRealDistributedExecution:
             assert line["metric"] == "allreduce_algo_bandwidth"
             assert line["devices"] == 2
             assert line["value"] > 0
+            # VERDICT r1 #10: north-star metric #2 lands in the CLUSTER
+            # metrics registry, not only the process log
+            snap = cl.metrics.snapshot()
+            assert snap["gauges"]["workload_allreduce_algo_bandwidth"] \
+                == pytest.approx(line["value"])
+            assert "workload_allreduce_algo_bandwidth" in snap["histograms"]
         finally:
             cl.close()
 
