@@ -78,7 +78,8 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
 class SimCluster:
     def __init__(self, slice_types: list[str], real_processes: bool = False,
                  extra_env: dict[str, str] | None = None,
-                 config: "KubeTpuConfig | None" = None):
+                 config: "KubeTpuConfig | None" = None,
+                 wire_cri: bool = False):
         from kubegpu_tpu.allocator import GangAllocator
         from kubegpu_tpu.config import KubeTpuConfig
 
@@ -92,9 +93,23 @@ class SimCluster:
             self.runtime = SubprocessRuntime(extra_env=merged_env)
         else:
             self.runtime = FakeRuntime()
-        self.agents = [NodeAgent(self.api, b, self.runtime,
-                                 metrics=self.metrics)
-                       for b in mock_cluster(slice_types)]
+        self.cri_servers: list["CriServer"] = []
+        if wire_cri or cfg.runtime.wire_cri:
+            # per-node CRI unix socket between agent (kubelet role) and
+            # shim, as in the reference deployment (SURVEY.md §4.3)
+            from kubegpu_tpu.crishim.criserver import CriServer, RemoteCriShim
+            self.agents = []
+            for b in mock_cluster(slice_types):
+                server = CriServer(self.api, b, b.discover().node_name,
+                                   self.runtime).start()
+                self.cri_servers.append(server)
+                self.agents.append(NodeAgent(
+                    self.api, b, self.runtime, metrics=self.metrics,
+                    shim=RemoteCriShim(server.socket_path)))
+        else:
+            self.agents = [NodeAgent(self.api, b, self.runtime,
+                                     metrics=self.metrics)
+                           for b in mock_cluster(slice_types)]
         for a in self.agents:
             a.register()
         sc = cfg.scheduler
@@ -286,3 +301,7 @@ class SimCluster:
         for a in self.agents:
             for h in a.handles.values():
                 h.kill()
+            if hasattr(a.shim, "close"):
+                a.shim.close()
+        for s in self.cri_servers:
+            s.close()

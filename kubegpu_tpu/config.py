@@ -49,6 +49,9 @@ class RuntimeConfig:
 
     real_processes: bool = False
     extra_env: dict[str, str] = field(default_factory=dict)
+    # route agent→shim container calls over a CRI-shaped unix socket
+    # (criserver.py) instead of in-process — the reference's transport
+    wire_cri: bool = False
 
 
 @dataclass

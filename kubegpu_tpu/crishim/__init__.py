@@ -19,8 +19,15 @@ from kubegpu_tpu.crishim.runtime import (
 )
 from kubegpu_tpu.crishim.shim import CriShim
 from kubegpu_tpu.crishim.agent import NodeAgent
+from kubegpu_tpu.crishim.criserver import (
+    CriClient,
+    CriError,
+    CriServer,
+    RemoteCriShim,
+)
 
 __all__ = [
     "ContainerHandle", "ContainerRuntime", "FakeRuntime",
     "SubprocessRuntime", "CriShim", "NodeAgent",
+    "CriServer", "CriClient", "CriError", "RemoteCriShim",
 ]

@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import math
 
+from kubegpu_tpu.crishim.criserver import CriError
 from kubegpu_tpu.crishim.runtime import ContainerHandle, ContainerRuntime
 from kubegpu_tpu.crishim.shim import CriShim
 from kubegpu_tpu.kubemeta import (
@@ -59,14 +60,19 @@ def harvest_workload_metrics(stdout: str, metrics: MetricsRegistry,
 class NodeAgent:
     def __init__(self, api: FakeApiServer, backend: DeviceBackend,
                  runtime: ContainerRuntime,
-                 metrics: MetricsRegistry | None = None):
+                 metrics: MetricsRegistry | None = None,
+                 shim=None):
         self.api = api
         self.backend = backend
         self.adv = backend.discover()
         self.node_name = self.adv.node_name
         self.runtime = runtime
         self.metrics = metrics
-        self.shim = CriShim(api, backend, self.node_name, runtime)
+        # shim override: a RemoteCriShim here sends every container call
+        # over the CRI unix socket (criserver.py) instead of in-process —
+        # the kubelet→crishim transport of the reference (SURVEY.md §4.3)
+        self.shim = shim if shim is not None else CriShim(
+            api, backend, self.node_name, runtime)
         self.handles: dict[str, ContainerHandle] = {}  # pod name → handle
         self._uids: dict[str, str] = {}  # pod name → uid of the incarnation
         self._ns: dict[str, str] = {}    # pod name → namespace
@@ -140,7 +146,16 @@ class NodeAgent:
         for pod in self.api.list("Pod", node_name=self.node_name,
                                  phase=PodPhase.SCHEDULED):
             if pod.name not in self.handles:
-                handle = self.shim.create_container(pod)
+                try:
+                    handle = self.shim.create_container(pod)
+                except CriError:
+                    # over the CRI wire the server re-fetches the pod, so
+                    # a delete/evict+recreate racing this pass surfaces
+                    # here (pod gone / uid mismatch): skip this pod — the
+                    # next pass sees the new incarnation — and never abort
+                    # the other pods' starts (mirrors the NotFound catch
+                    # on the phase write below)
+                    continue
                 self.handles[pod.name] = handle
                 self._uids[pod.name] = pod.metadata.uid
                 self._ns[pod.name] = pod.metadata.namespace

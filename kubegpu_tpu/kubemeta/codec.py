@@ -233,3 +233,38 @@ def set_pod_multislice(pod: Pod, allowed: bool = True) -> None:
 
 def pod_multislice(pod: Pod) -> bool:
     return pod.metadata.annotations.get(MULTISLICE_KEY) == "true"
+
+
+def migration_debt_to_annotation(req: "GangRequest") -> str:
+    """Serialize a migrated gang's reserved re-ask (``MIGRATION_DEBT_KEY``
+    payload).  Lives here with every other annotation codec so the wire
+    format has one home; ``GangRequest`` is imported lazily because the
+    allocator itself imports this module."""
+    return json.dumps({
+        "numPods": req.num_pods,
+        "chipsPerPod": req.chips_per_pod,
+        "millitpuPerPod": req.millitpu_per_pod,
+        "hbmGibPerChip": req.hbm_gib_per_chip,
+        "meshAxes": (list(req.mesh_axes.items())
+                     if req.mesh_axes else None),
+        "allowMultislice": req.allow_multislice,
+    }, sort_keys=True)
+
+
+def migration_debt_from_annotation(gang_key: str,
+                                   payload: str) -> "GangRequest | None":
+    from kubegpu_tpu.allocator.gang import GangRequest
+
+    try:
+        d = json.loads(payload)
+        return GangRequest(
+            gang_name=gang_key,
+            num_pods=int(d["numPods"]),
+            chips_per_pod=int(d["chipsPerPod"]),
+            millitpu_per_pod=int(d.get("millitpuPerPod", 0)),
+            hbm_gib_per_chip=float(d.get("hbmGibPerChip", 0.0)),
+            mesh_axes=dict((k, int(v)) for k, v in d["meshAxes"])
+            if d.get("meshAxes") else None,
+            allow_multislice=bool(d.get("allowMultislice", False)))
+    except (ValueError, KeyError, TypeError):
+        return None   # malformed debt: drop the reservation, not the pod

@@ -49,6 +49,8 @@ from kubegpu_tpu.kubemeta import (
 from kubegpu_tpu.kubemeta.codec import (
     ALLOCATE_FROM_KEY,
     MIGRATION_DEBT_KEY,
+    migration_debt_from_annotation,
+    migration_debt_to_annotation,
     allocation_to_annotation,
     node_advertisement,
 )
@@ -146,38 +148,6 @@ class DeviceScheduler:
         return ns, bare
 
     @staticmethod
-    def _debt_to_annotation(req: GangRequest) -> str:
-        import json
-
-        return json.dumps({
-            "numPods": req.num_pods,
-            "chipsPerPod": req.chips_per_pod,
-            "millitpuPerPod": req.millitpu_per_pod,
-            "hbmGibPerChip": req.hbm_gib_per_chip,
-            "meshAxes": (list(req.mesh_axes.items())
-                         if req.mesh_axes else None),
-            "allowMultislice": req.allow_multislice,
-        }, sort_keys=True)
-
-    @staticmethod
-    def _debt_from_annotation(gkey: str, payload: str) -> GangRequest | None:
-        import json
-
-        try:
-            d = json.loads(payload)
-            return GangRequest(
-                gang_name=gkey,
-                num_pods=int(d["numPods"]),
-                chips_per_pod=int(d["chipsPerPod"]),
-                millitpu_per_pod=int(d.get("millitpuPerPod", 0)),
-                hbm_gib_per_chip=float(d.get("hbmGibPerChip", 0.0)),
-                mesh_axes=dict((k, int(v)) for k, v in d["meshAxes"])
-                if d.get("meshAxes") else None,
-                allow_multislice=bool(d.get("allowMultislice", False)))
-        except (ValueError, KeyError, TypeError):
-            return None   # malformed debt: drop the reservation, not the pod
-
-    @staticmethod
     def _arrival(pod: Pod) -> int:
         """Queue position: the original arrival for requeued pods."""
         from kubegpu_tpu.kubemeta.codec import QUEUED_AT_KEY
@@ -255,7 +225,7 @@ class DeviceScheduler:
                               gs.name if gs else pod.name)
             if gkey in self._migration_debts:
                 continue   # every member carries the same debt
-            req = self._debt_from_annotation(gkey, payload)
+            req = migration_debt_from_annotation(gkey, payload)
             if req is not None:
                 self._migration_debts[gkey] = req
         for gang, allocs in gang_pods.items():
@@ -1009,7 +979,7 @@ class DeviceScheduler:
                         # restart must not drop the home reservation
                         # (annotation truth — advisor r1 finding)
                         vns = self._split_gkey(victim)[0]
-                        payload = self._debt_to_annotation(vreq)
+                        payload = migration_debt_to_annotation(vreq)
                         from kubegpu_tpu.kubemeta import NotFound
                         for pname in requeued:
                             try:
