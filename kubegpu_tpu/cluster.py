@@ -94,22 +94,22 @@ class SimCluster:
         else:
             self.runtime = FakeRuntime()
         self.cri_servers: list["CriServer"] = []
-        if wire_cri or cfg.runtime.wire_cri:
-            # per-node CRI unix socket between agent (kubelet role) and
-            # shim, as in the reference deployment (SURVEY.md §4.3)
-            from kubegpu_tpu.crishim.criserver import CriServer, RemoteCriShim
-            self.agents = []
-            for b in mock_cluster(slice_types):
+        self.agents = []
+        for b in mock_cluster(slice_types):
+            shim = None
+            if wire_cri or cfg.runtime.wire_cri:
+                # per-node CRI unix socket between agent (kubelet role)
+                # and shim, as in the reference deployment (SURVEY §4.3)
+                from kubegpu_tpu.crishim.criserver import (
+                    CriServer,
+                    RemoteCriShim,
+                )
                 server = CriServer(self.api, b, b.discover().node_name,
                                    self.runtime).start()
                 self.cri_servers.append(server)
-                self.agents.append(NodeAgent(
-                    self.api, b, self.runtime, metrics=self.metrics,
-                    shim=RemoteCriShim(server.socket_path)))
-        else:
-            self.agents = [NodeAgent(self.api, b, self.runtime,
-                                     metrics=self.metrics)
-                           for b in mock_cluster(slice_types)]
+                shim = RemoteCriShim(server.socket_path)
+            self.agents.append(NodeAgent(self.api, b, self.runtime,
+                                         metrics=self.metrics, shim=shim))
         for a in self.agents:
             a.register()
         sc = cfg.scheduler

@@ -24,8 +24,10 @@ from kubegpu_tpu.kubemeta.codec import (
     DEVICE_INFO_KEY,
     node_advertisement_to_annotation,
 )
-from kubegpu_tpu.obs import MetricsRegistry
+from kubegpu_tpu.obs import MetricsRegistry, get_logger
 from kubegpu_tpu.tpuplugin.backend import DeviceBackend
+
+log = get_logger("nodeagent")
 
 
 def harvest_workload_metrics(stdout: str, metrics: MetricsRegistry,
@@ -148,13 +150,18 @@ class NodeAgent:
             if pod.name not in self.handles:
                 try:
                     handle = self.shim.create_container(pod)
-                except CriError:
+                except CriError as e:
                     # over the CRI wire the server re-fetches the pod, so
                     # a delete/evict+recreate racing this pass surfaces
                     # here (pod gone / uid mismatch): skip this pod — the
                     # next pass sees the new incarnation — and never abort
                     # the other pods' starts (mirrors the NotFound catch
-                    # on the phase write below)
+                    # on the phase write below).  Logged loudly because
+                    # the same frame also carries non-transient server
+                    # errors (e.g. wrong-node allocation): a pod stuck
+                    # SCHEDULED shows why here instead of failing silently.
+                    log.warning("create_container_failed", pod=pod.name,
+                                node=self.node_name, error=str(e))
                     continue
                 self.handles[pod.name] = handle
                 self._uids[pod.name] = pod.metadata.uid

@@ -266,8 +266,7 @@ class CriServer:
             items = list(self._handles.items())
         out = []
         for cid, h in items:
-            running = (h.exit_code is None
-                       and (h._proc is None or h._proc.poll() is None))
+            running = h.running()
             out.append({
                 "id": cid,
                 "metadata": {"name": h.container_name},

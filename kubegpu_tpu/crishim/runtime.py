@@ -26,6 +26,12 @@ class ContainerHandle:
     stderr: str = ""
     _proc: subprocess.Popen | None = field(default=None, repr=False)
 
+    def running(self) -> bool:
+        """Liveness without collecting output (CRI ListContainers)."""
+        if self.exit_code is not None:
+            return False
+        return self._proc is not None and self._proc.poll() is None
+
     def wait(self, timeout: float | None = None) -> int | None:
         if self._proc is not None:
             try:

@@ -58,6 +58,12 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # reference ~3.3x; 128-multiples keep the MXU tiled on every generation).
 BLOCK_Q = 256
 BLOCK_K = 512
+# Backward prefers a taller q-block (bench sweep 2026-07-30 on v5e, both
+# hd=64 and hd=128: 512/512 beats the forward's 256/512 by ~1.5-2x — the
+# dq and dkv kernels run 3 matmuls per (q,k) block pair, so amortizing
+# the per-block softmax recompute over more rows wins).
+BLOCK_Q_BWD = 512
+BLOCK_K_BWD = 512
 # lse/delta ride in [*, t, LSE_LANES] tiles: queries on sublanes (so
 # per-row broadcasts need no transpose), a full size-8 lane dim to
 # satisfy the TPU (8, 128)-or-full block rule at f32 tiling.
@@ -194,7 +200,8 @@ def _xla_lse(q, k, causal, scale):
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
-                        block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
+                        block_q: int = BLOCK_Q_BWD,
+                        block_k: int = BLOCK_K_BWD,
                         interpret: bool = False):
     """Pallas flash-attention backward: (dq, dk, dv) with the logsumexp
     trick — no T² residual was saved; scores recompute blockwise.
@@ -378,7 +385,10 @@ def _flash_diff(q, k, v, causal, interpret):
 
 def _flash_diff_fwd(q, k, v, causal, interpret):
     t, s = q.shape[2], k.shape[2]
-    if t % min(BLOCK_Q, t) or s % min(BLOCK_K, s):
+    # both the forward's AND the backward's blocks must tile (the bwd
+    # defaults are taller, e.g. t=768 tiles 256 but not 512)
+    if (t % min(BLOCK_Q, t) or s % min(BLOCK_K, s)
+            or t % min(BLOCK_Q_BWD, t) or s % min(BLOCK_K_BWD, s)):
         # fallback shapes: no lse; bwd re-derives through XLA
         return (flash_attention(q, k, v, causal=causal,
                                 interpret=interpret),

@@ -171,6 +171,47 @@ class TestFlashBackward:
                 np.asarray(g), np.asarray(r), atol=5e-4, rtol=5e-4,
                 err_msg=name)
 
+    def test_head_dim_128_parity(self):
+        """Llama-3's real head geometry (hd=128, GQA group 4) — the
+        bench config's layout — fwd and bwd parity."""
+        from kubegpu_tpu.ops.flash_attention import attention
+        q, k, v = rand_qkv(jax.random.PRNGKey(10), hq=4, hkv=1,
+                           t=128, s=128, d=128)
+        ref_out = xla_attention(q, k, v, causal=True)
+        got_out = attention(q, k, v, causal=True,
+                            impl="pallas_interpret")
+        np.testing.assert_allclose(np.asarray(got_out),
+                                   np.asarray(ref_out),
+                                   atol=2e-5, rtol=2e-5)
+        ref = self._grads(
+            lambda a, b, c: xla_attention(a, b, c, causal=True),
+            q, k, v)
+        got = self._grads(
+            lambda a, b, c: attention(a, b, c, causal=True,
+                                      impl="pallas_interpret"),
+            q, k, v)
+        for g, r, name in zip(got, ref, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=5e-4, rtol=5e-4,
+                err_msg=name)
+
+    def test_fwd_tiling_but_not_bwd_falls_back(self):
+        """The backward's taller default blocks (512) must gate the
+        pallas-vjp path too: t=768 tiles the forward's 256 but not 512;
+        saving an lse residual there would crash the bwd kernel's
+        tiling assert at grad time."""
+        from kubegpu_tpu.ops.flash_attention import (
+            BLOCK_Q,
+            BLOCK_Q_BWD,
+            _flash_diff_fwd,
+        )
+        t = BLOCK_Q * 3
+        assert t % BLOCK_Q == 0 and t % BLOCK_Q_BWD != 0
+        q, k, v = rand_qkv(jax.random.PRNGKey(11), b=1, hq=1, hkv=1,
+                           t=t, s=t, d=8)
+        _, res = _flash_diff_fwd(q, k, v, True, True)
+        assert res[3] is None and res[4] is None  # lse-less: XLA vjp
+
     def test_fallback_shapes_still_differentiable(self):
         """Non-tiling shapes take the XLA-VJP fallback inside the
         custom vjp.  t=s=320 > BLOCK_Q=256 and 320 % 256 != 0, so this
