@@ -383,12 +383,19 @@ def _flash_diff(q, k, v, causal, interpret):
     return flash_attention(q, k, v, causal=causal, interpret=interpret)
 
 
+def _bwd_blocks(t: int, s: int) -> tuple[int, int]:
+    """Backward block sizes for a given (t, s): the taller bwd defaults
+    when they tile, else the forward's blocks (which the pallas-path
+    gate already guarantees tile) — e.g. t=768 tiles 256 but not 512,
+    and must not lose the pallas backward over it."""
+    bq = BLOCK_Q_BWD if t % min(BLOCK_Q_BWD, t) == 0 else BLOCK_Q
+    bk = BLOCK_K_BWD if s % min(BLOCK_K_BWD, s) == 0 else BLOCK_K
+    return bq, bk
+
+
 def _flash_diff_fwd(q, k, v, causal, interpret):
     t, s = q.shape[2], k.shape[2]
-    # both the forward's AND the backward's blocks must tile (the bwd
-    # defaults are taller, e.g. t=768 tiles 256 but not 512)
-    if (t % min(BLOCK_Q, t) or s % min(BLOCK_K, s)
-            or t % min(BLOCK_Q_BWD, t) or s % min(BLOCK_K_BWD, s)):
+    if t % min(BLOCK_Q, t) or s % min(BLOCK_K, s):
         # fallback shapes: no lse; bwd re-derives through XLA
         return (flash_attention(q, k, v, causal=causal,
                                 interpret=interpret),
@@ -405,7 +412,9 @@ def _flash_diff_bwd(causal, interpret, res, g):
             lambda q_, k_, v_: xla_attention(q_, k_, v_, causal=causal),
             q, k, v)
         return vjp(g)
+    bq, bk = _bwd_blocks(q.shape[2], k.shape[2])
     return flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
+                               block_q=bq, block_k=bk,
                                interpret=interpret)
 
 

@@ -195,22 +195,38 @@ class TestFlashBackward:
                 np.asarray(g), np.asarray(r), atol=5e-4, rtol=5e-4,
                 err_msg=name)
 
-    def test_fwd_tiling_but_not_bwd_falls_back(self):
-        """The backward's taller default blocks (512) must gate the
-        pallas-vjp path too: t=768 tiles the forward's 256 but not 512;
-        saving an lse residual there would crash the bwd kernel's
-        tiling assert at grad time."""
+    def test_fwd_tiling_but_not_bwd_keeps_pallas(self):
+        """t=768 tiles the forward's 256 blocks but not the backward's
+        taller 512 default: the bwd must drop to the forward's blocks
+        (not abandon the pallas path, and not trip its tiling assert).
+        Asserts the block choice AND end-to-end grad parity there."""
         from kubegpu_tpu.ops.flash_attention import (
+            BLOCK_K,
             BLOCK_Q,
             BLOCK_Q_BWD,
+            _bwd_blocks,
             _flash_diff_fwd,
+            attention,
         )
-        t = BLOCK_Q * 3
+        t, s = BLOCK_Q * 3, BLOCK_K * 2   # 768 x 1024: t tiles 256 only
         assert t % BLOCK_Q == 0 and t % BLOCK_Q_BWD != 0
-        q, k, v = rand_qkv(jax.random.PRNGKey(11), b=1, hq=1, hkv=1,
-                           t=t, s=t, d=8)
+        assert s % BLOCK_K == 0
+        assert _bwd_blocks(t, s) == (BLOCK_Q, BLOCK_K)
+        q, k, v = rand_qkv(jax.random.PRNGKey(11), b=1, hq=2, hkv=2,
+                           t=t, s=s, d=16)
         _, res = _flash_diff_fwd(q, k, v, True, True)
-        assert res[3] is None and res[4] is None  # lse-less: XLA vjp
+        assert res[4] is not None  # lse saved: pallas bwd stays engaged
+        ref = self._grads(
+            lambda a, b, c: xla_attention(a, b, c, causal=True),
+            q, k, v)
+        got = self._grads(
+            lambda a, b, c: attention(a, b, c, causal=True,
+                                      impl="pallas_interpret"),
+            q, k, v)
+        for g, r, name in zip(got, ref, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=5e-4, rtol=5e-4,
+                err_msg=name)
 
     def test_fallback_shapes_still_differentiable(self):
         """Non-tiling shapes take the XLA-VJP fallback inside the
