@@ -1,0 +1,127 @@
+"""Standalone crishim node daemon: ``python -m kubegpu_tpu.crishim.serve``.
+
+The reference's ``crishim main()`` (SURVEY.md §4.1): parse flags → load
+the device plugin → start the CRI server on a unix socket → run the
+kubeadvertise loop against the apiserver.  This is that binary for the
+TPU stack: it connects to the HTTP apiserver façade
+(``kubemeta/apiserver_http.py``), registers the node, serves the
+CRI-shaped socket, and runs the kubelet-ish pod lifecycle — in its own
+process, talking to the control plane over nothing but HTTP + the unix
+socket, exactly like the reference deployment.
+
+    python -m kubegpu_tpu.crishim.serve \
+        --apiserver http://127.0.0.1:8901 \
+        --backend mock --slice v4-8 \
+        --cri-socket /tmp/kubetpu-cri.sock \
+        --real-processes
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from kubegpu_tpu.kubemeta.controlplane import Conflict, NotFound
+
+
+def build_agent(args):
+    """Construct (api client, CRI server, node agent) from flags —
+    split from main() so tests can drive the daemon in-process."""
+    from kubegpu_tpu.crishim.agent import NodeAgent
+    from kubegpu_tpu.crishim.criserver import CriServer, RemoteCriShim
+    from kubegpu_tpu.crishim.runtime import FakeRuntime, SubprocessRuntime
+    from kubegpu_tpu.kubemeta.apiserver_http import HttpApiClient
+    from kubegpu_tpu.obs import global_registry
+    from kubegpu_tpu.tpuplugin import LibtpuBackend, MockBackend
+
+    api = HttpApiClient(args.apiserver)
+    if args.backend == "mock":
+        backend = MockBackend(args.slice, host_id=args.host_id)
+    elif args.backend == "libtpu":
+        backend = LibtpuBackend()
+    else:
+        raise ValueError(f"unknown backend {args.backend!r}")
+    if args.real_processes:
+        extra = dict(kv.split("=", 1) for kv in (args.env or []))
+        runtime = SubprocessRuntime(extra_env=extra)
+    else:
+        runtime = FakeRuntime()
+    node_name = backend.discover().node_name
+    server = CriServer(api, backend, node_name, runtime,
+                       socket_path=args.cri_socket).start()
+    agent = NodeAgent(api, backend, runtime,
+                      metrics=global_registry,
+                      shim=RemoteCriShim(server.socket_path))
+    return api, server, agent
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubetpu-crishim",
+        description="node daemon: CRI-shaped runtime socket + device "
+        "advertiser + pod lifecycle (reference: crishim main())")
+    ap.add_argument("--apiserver", required=True,
+                    help="HTTP apiserver URL (kubemeta.apiserver_http)")
+    ap.add_argument("--backend", default="mock",
+                    choices=["mock", "libtpu"])
+    ap.add_argument("--slice", default="v4-8",
+                    help="mock backend slice type")
+    ap.add_argument("--host-id", type=int, default=0,
+                    help="mock backend host index within the slice")
+    ap.add_argument("--cri-socket", default=None,
+                    help="unix socket path for the CRI server "
+                    "(default: a fresh temp path, printed at startup)")
+    ap.add_argument("--real-processes", action="store_true",
+                    help="launch real workload subprocesses")
+    ap.add_argument("--env", action="append", metavar="K=V",
+                    help="extra env for launched workloads, repeatable")
+    ap.add_argument("--advertise-interval", type=float, default=5.0,
+                    help="seconds between Node advertisement patches")
+    ap.add_argument("--tick", type=float, default=0.2,
+                    help="pod-lifecycle reconcile interval (seconds)")
+    args = ap.parse_args(argv)
+
+    api, server, agent = build_agent(args)
+    agent.register()
+    print(f"crishim: node {agent.node_name} registered; "
+          f"CRI socket {server.socket_path}", file=sys.stderr)
+
+    last_advertise = time.monotonic()
+    backoff = args.tick
+    try:
+        while True:
+            try:
+                agent.run_once()
+                agent.reap(timeout=0)
+                now = time.monotonic()
+                if now - last_advertise >= args.advertise_interval:
+                    agent.advertise()
+                    last_advertise = now
+                backoff = args.tick
+            except (OSError, ValueError, NotFound, Conflict) as e:
+                # transient control-plane failure (apiserver restart,
+                # connection reset, our Node object wiped): a
+                # kubelet-shaped daemon backs off and retries — it must
+                # NOT die and orphan its containers and registration
+                print(f"crishim: control-plane error, retrying in "
+                      f"{backoff:.1f}s: {e}", file=sys.stderr)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+                if isinstance(e, NotFound):
+                    try:   # Node object gone (apiserver state reset)
+                        agent.register()
+                    except Exception:
+                        pass
+                continue
+            time.sleep(args.tick)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+        api.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,8 @@ from kubegpu_tpu.kubemeta.controlplane import (
     NotFound,
     WatchEvent,
 )
+from kubegpu_tpu.kubemeta.apiserver_http import ApiServerHTTP, HttpApiClient
+from kubegpu_tpu.kubemeta.serialize import from_doc, to_doc
 
 __all__ = [
     "ContainerSpec", "GangSpec", "Node", "ObjectMeta", "Pod", "PodPhase",
@@ -65,4 +67,5 @@ __all__ = [
     "set_pod_allocation", "set_pod_migratable",
     "set_pod_gang", "set_pod_mesh_axes", "set_pod_multislice",
     "Conflict", "FakeApiServer", "NotFound", "WatchEvent",
+    "ApiServerHTTP", "HttpApiClient", "from_doc", "to_doc",
 ]

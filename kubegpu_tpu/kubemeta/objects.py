@@ -11,10 +11,8 @@ because the mesh is explicit, not path-encoded).
 from __future__ import annotations
 
 import enum
-import itertools
+import uuid
 from dataclasses import dataclass, field
-
-_uid_counter = itertools.count(1)
 
 # Resource names (user surface, pod spec `resources`):
 RES_TPU_CHIPS = "kubetpu.io/tpu-chips"     # whole chips per container
@@ -36,7 +34,12 @@ class ObjectMeta:
     namespace: str = "default"
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
-    uid: str = field(default_factory=lambda: f"uid-{next(_uid_counter)}")
+    # globally unique, not a per-process counter: uids cross process
+    # boundaries on the apiserver wire, and the uid-incarnation guards
+    # (set_pod_phase expect_uid, NodeAgent.reconcile, CRI create) must
+    # never confuse two processes' counters for the same incarnation
+    uid: str = field(
+        default_factory=lambda: f"uid-{uuid.uuid4().hex[:16]}")
     resource_version: int = 0
 
     def clone(self) -> "ObjectMeta":

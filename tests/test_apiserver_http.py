@@ -1,0 +1,318 @@
+"""HTTP apiserver façade (SURVEY.md §2 key property on a real wire):
+every scheduler↔agent coordination path must work with the control plane
+behind HTTP, and the node daemon must run against it out-of-process."""
+
+import threading
+import time
+
+import pytest
+
+from kubegpu_tpu.cluster import tpu_pod
+from kubegpu_tpu.kubemeta import (
+    Conflict,
+    FakeApiServer,
+    GangSpec,
+    Node,
+    NotFound,
+    ObjectMeta,
+    PodPhase,
+    Quota,
+    QuotaSpec,
+)
+from kubegpu_tpu.kubemeta.apiserver_http import ApiServerHTTP, HttpApiClient
+from kubegpu_tpu.kubemeta.codec import pod_gang_spec, set_pod_gang
+from kubegpu_tpu.kubemeta.serialize import from_doc, to_doc
+
+
+@pytest.fixture
+def served():
+    api = FakeApiServer()
+    srv = ApiServerHTTP(api).start()
+    client = HttpApiClient(srv.address)
+    yield api, srv, client
+    client.close()
+    srv.close()
+
+
+class TestSerialize:
+    def test_pod_roundtrip(self):
+        pod = tpu_pod("p", chips=2, command=["python", "-m", "x"],
+                      env={"A": "1"}, priority=3, namespace="team-a",
+                      gang=GangSpec(name="g", size=4, index=1),
+                      mesh_axes={"dp": 2, "tp": 2}, hbm_gib=8.0)
+        pod.spec.node_name = "node-0"
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.exit_code = None
+        back = from_doc("Pod", to_doc("Pod", pod))
+        assert back.metadata.name == "p"
+        assert back.metadata.namespace == "team-a"
+        assert back.metadata.uid == pod.metadata.uid
+        assert back.spec.node_name == "node-0"
+        assert back.spec.priority == 3
+        assert back.status.phase == PodPhase.RUNNING
+        c = back.spec.containers[0]
+        assert c.resources.tpu_chips == 2
+        assert c.resources.hbm_gib == 8.0
+        assert c.command == ["python", "-m", "x"]
+        assert c.env == {"A": "1"}
+        # annotation payloads (gang etc.) survive verbatim
+        assert pod_gang_spec(back) == GangSpec(name="g", size=4, index=1)
+
+    def test_node_and_quota_roundtrip(self):
+        node = Node(metadata=ObjectMeta(name="n0",
+                                        annotations={"k": "v"}))
+        node.status.ready = False
+        back = from_doc("Node", to_doc("Node", node))
+        assert back.name == "n0" and back.status.ready is False
+        assert back.metadata.annotations == {"k": "v"}
+        q = Quota(metadata=ObjectMeta(name="quota", namespace="t"),
+                  spec=QuotaSpec(tpu_chips=8, millitpu=None))
+        back = from_doc("Quota", to_doc("Quota", q))
+        assert back.spec.tpu_chips == 8 and back.spec.millitpu is None
+
+
+class TestRestSurface:
+    def test_crud_roundtrip(self, served):
+        api, srv, client = served
+        client.create("Pod", tpu_pod("p", chips=1, command=["x"]))
+        got = client.get("Pod", "p")
+        assert got.name == "p"
+        assert api.get("Pod", "p").metadata.uid == got.metadata.uid
+        with pytest.raises(Conflict):
+            client.create("Pod", tpu_pod("p", chips=1, command=["x"]))
+        client.delete("Pod", "p")
+        with pytest.raises(NotFound):
+            client.get("Pod", "p")
+
+    def test_field_selectors_over_wire(self, served):
+        api, srv, client = served
+        client.create("Pod", tpu_pod("a", chips=1, command=["x"]))
+        client.create("Pod", tpu_pod("b", chips=1, command=["x"],
+                                     namespace="other"))
+        client.bind_pod("a", "node-0")
+        assert [p.name for p in client.list(
+            "Pod", node_name="node-0", phase=PodPhase.SCHEDULED)] == ["a"]
+        assert [p.name for p in client.list(
+            "Pod", namespace="other")] == ["b"]
+        assert client.list("Pod", node_name="nope") == []
+
+    def test_annotation_patch_with_null_delete(self, served):
+        api, srv, client = served
+        client.create("Pod", tpu_pod("p", chips=0, command=["x"]))
+        client.patch_annotations("Pod", "p", {"x": "1", "y": "2"})
+        client.patch_annotations("Pod", "p", {"x": None})
+        assert client.get("Pod", "p").metadata.annotations.get("y") == "2"
+        assert "x" not in client.get("Pod", "p").metadata.annotations
+
+    def test_status_subresource_incarnation_safe(self, served):
+        api, srv, client = served
+        client.create("Pod", tpu_pod("p", chips=0, command=["x"]))
+        uid = client.get("Pod", "p").metadata.uid
+        client.set_pod_phase("p", PodPhase.RUNNING, expect_uid=uid)
+        assert client.get("Pod", "p").status.phase == PodPhase.RUNNING
+        with pytest.raises(NotFound, match="recreated"):
+            client.set_pod_phase("p", PodPhase.FAILED,
+                                 expect_uid="uid-of-the-dead")
+
+    def test_node_ready_subresource(self, served):
+        api, srv, client = served
+        client.create("Node", Node(metadata=ObjectMeta(name="n0")))
+        client.set_node_ready("n0", False)
+        assert api.get("Node", "n0").status.ready is False
+
+    def test_update_optimistic_concurrency(self, served):
+        api, srv, client = served
+        client.create("Pod", tpu_pod("p", chips=0, command=["x"]))
+        pod = client.get("Pod", "p")
+        pod.spec.priority = 9
+        client.update("Pod", pod)
+        stale = pod  # rv now behind
+        stale.spec.priority = 1
+        with pytest.raises(Conflict):
+            client.update("Pod", stale)
+
+    def test_watch_long_poll_no_history_replay(self, served):
+        api, srv, client = served
+        client.create("Pod", tpu_pod("old", chips=0, command=["x"]))
+        seen: list[tuple[str, str]] = []
+        done = threading.Event()
+
+        def cb(ev):
+            seen.append((ev.type, ev.obj.metadata.name))
+            done.set()
+
+        unsub = client.watch(cb)
+        time.sleep(0.15)  # let the tail handshake land
+        client.create("Pod", tpu_pod("fresh", chips=0, command=["x"]))
+        assert done.wait(5.0)
+        unsub()
+        assert ("ADDED", "fresh") in seen
+        # the pre-subscribe object was NOT replayed
+        assert all(name != "old" for _, name in seen)
+
+    def test_watch_resubscribe_after_full_unsubscribe(self, served):
+        """Regression (review): a new watcher registered while the old
+        poll thread is still winding down after the last unsubscribe
+        must still get events (each generation has its own stop flag)."""
+        api, srv, client = served
+        unsub1 = client.watch(lambda ev: None)
+        unsub1()   # old thread may still be inside its long-poll
+        got = threading.Event()
+        unsub2 = client.watch(lambda ev: got.set())
+        time.sleep(0.15)
+        client.create("Pod", tpu_pod("after", chips=0, command=["x"]))
+        assert got.wait(5.0), "re-subscribed watcher starved of events"
+        unsub2()
+
+
+class TestOutOfProcessAgent:
+    """The crishim daemon shape: NodeAgent + CriServer talking to the
+    control plane ONLY via HttpApiClient, scheduler in the main process
+    — the reference's deployment topology (SURVEY.md §4)."""
+
+    def _cluster_with_remote_agent(self):
+        from kubegpu_tpu.allocator import GangAllocator
+        from kubegpu_tpu.crishim.agent import NodeAgent
+        from kubegpu_tpu.crishim.criserver import CriServer, RemoteCriShim
+        from kubegpu_tpu.crishim.runtime import FakeRuntime
+        from kubegpu_tpu.scheduler import DeviceScheduler
+        from kubegpu_tpu.tpuplugin import MockBackend
+
+        api = FakeApiServer()
+        srv = ApiServerHTTP(api).start()
+        client = HttpApiClient(srv.address)
+        backend = MockBackend("v4-8")
+        runtime = FakeRuntime()
+        cri = CriServer(client, backend, backend.discover().node_name,
+                        runtime).start()
+        agent = NodeAgent(client, backend, runtime,
+                          shim=RemoteCriShim(cri.socket_path))
+        agent.register()
+        sched = DeviceScheduler(api, allocator=GangAllocator())
+        return api, srv, client, cri, agent, sched, runtime
+
+    def test_full_path_over_http_and_socket(self):
+        api, srv, client, cri, agent, sched, runtime = \
+            self._cluster_with_remote_agent()
+        try:
+            # node registered THROUGH the HTTP wire is visible in-process
+            assert api.get("Node", agent.node_name) is not None
+            api.create("Pod", tpu_pod("job", chips=2, command=["x"]))
+            res = sched.run_once()
+            assert res.scheduled == ["job"]
+            started = agent.run_once()   # HTTP list → CRI socket create
+            assert len(started) == 1
+            assert len(started[0].env["TPU_VISIBLE_CHIPS"].split(",")) == 2
+            assert agent.reap(timeout=2) == {"job": 0}
+            assert api.get("Pod", "job").status.phase == PodPhase.SUCCEEDED
+        finally:
+            client.close()
+            cri.close()
+            srv.close()
+
+    def test_daemon_builder(self, tmp_path):
+        """crishim/serve.py's build_agent wires the same topology from
+        flags (the daemon's entry path, minus the forever-loop)."""
+        import argparse
+
+        from kubegpu_tpu.crishim.serve import build_agent
+
+        api = FakeApiServer()
+        srv = ApiServerHTTP(api).start()
+        args = argparse.Namespace(
+            apiserver=srv.address, backend="mock", slice="v4-8",
+            host_id=0, cri_socket=str(tmp_path / "cri.sock"),
+            real_processes=False, env=None)
+        client, cri, agent = build_agent(args)
+        try:
+            agent.register()
+            assert api.get("Node", agent.node_name) is not None
+            api.create("Pod", tpu_pod("p", chips=1, command=["x"]))
+            api.bind_pod("p", agent.node_name)
+            # inject the allocation annotation the scheduler would write
+            from kubegpu_tpu.kubemeta.codec import (
+                ALLOCATE_FROM_KEY,
+                Allocation,
+                AllocatedChip,
+                allocation_to_annotation,
+            )
+            adv = agent.backend.discover()
+            alloc = Allocation(
+                node_name=agent.node_name,
+                slice_id=adv.slice_id,
+                chips=[AllocatedChip(
+                    local_index=adv.chips[0].local_index,
+                    coord=adv.chips[0].coord, millichips=1000)],
+                worker_id=0, num_workers=1,
+                coordinator_address="127.0.0.1:9999",
+                worker_hostnames=["127.0.0.1"])
+            api.patch_annotations(
+                "Pod", "p",
+                {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc)})
+            started = agent.run_once()
+            assert len(started) == 1
+            assert started[0].env["TPU_WORKER_ID"] == "0"
+        finally:
+            client.close()
+            cri.close()
+            srv.close()
+
+
+class TestDaemonProcess:
+    """The real thing: crishim.serve as a SEPARATE PROCESS.  Control
+    plane in this process (HTTP façade), scheduler in this process,
+    node daemon out-of-process — a pod goes submit → schedule → bind →
+    (HTTP) → daemon → (CRI socket) → workload subprocess → reap →
+    SUCCEEDED with no in-process shortcut anywhere."""
+
+    @pytest.mark.slow
+    def test_pod_runs_through_external_daemon(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        from kubegpu_tpu.allocator import GangAllocator
+        from kubegpu_tpu.scheduler import DeviceScheduler
+
+        api = FakeApiServer()
+        srv = ApiServerHTTP(api).start()
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "kubegpu_tpu.crishim.serve",
+             "--apiserver", srv.address, "--backend", "mock",
+             "--slice", "v4-8",
+             "--cri-socket", str(tmp_path / "cri.sock"),
+             "--real-processes", "--tick", "0.05",
+             "--advertise-interval", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            # wait for the daemon to register its node over HTTP
+            deadline = time.monotonic() + 30
+            node_name = None
+            while time.monotonic() < deadline and node_name is None:
+                nodes = api.list("Node")
+                if nodes:
+                    node_name = nodes[0].name
+                time.sleep(0.1)
+            assert node_name, "daemon never registered a node"
+
+            sched = DeviceScheduler(api, allocator=GangAllocator())
+            api.create("Pod", tpu_pod(
+                "hello", chips=1,
+                command=[_sys.executable, "-c", "print('ran in daemon')"]))
+            res = sched.run_once()
+            assert res.scheduled == ["hello"]
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if api.get("Pod", "hello").status.phase == \
+                        PodPhase.SUCCEEDED:
+                    break
+                time.sleep(0.1)
+            assert api.get("Pod", "hello").status.phase == \
+                PodPhase.SUCCEEDED
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            srv.close()
