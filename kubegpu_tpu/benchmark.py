@@ -166,6 +166,55 @@ def _attention_bench(batch, heads, seq, hd, dtype, on_tpu) -> dict | None:
     }
 
 
+def _serving_bench(cfg, params, on_tpu) -> dict:
+    """Prefill latency + KV-cache decode throughput on the same params
+    the train bench just produced (models/decode.py scanned greedy
+    loop).  Timed as repeated whole-call dispatches with one end fetch:
+    device execution is serial, so N calls / elapsed is throughput even
+    when per-call blocking is a no-op under the async tunnel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.models import greedy_generate
+    from kubegpu_tpu.models.decode import prefill
+
+    if on_tpu:
+        batch, prompt_t, steps, iters = 8, 1024, 128, 3
+    else:
+        batch, prompt_t, steps, iters = 2, 8, 4, 2
+    max_len = prompt_t + steps
+    prompt = jnp.asarray(
+        np.arange(batch * prompt_t).reshape(batch, prompt_t)
+        % cfg.vocab_size, jnp.int32)
+
+    def timeit(fn, fetch, n):
+        out = fn()
+        _fetch_scalar(fetch(out))
+        rtt = _fetch_rtt_s(fetch(out))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        _fetch_scalar(fetch(out))
+        return max(time.perf_counter() - t0 - rtt, 1e-9) / n
+
+    pf = jax.jit(lambda p, t: prefill(p, t, cfg, max_len)[0])
+    prefill_s = timeit(lambda: pf(params, prompt), lambda o: o, iters)
+    gen_s = timeit(
+        lambda: greedy_generate(params, prompt, steps, cfg, max_len),
+        lambda o: o, iters)
+    decode_s = max(gen_s - prefill_s, 1e-9)
+    return {
+        "batch": batch,
+        "prompt_len": prompt_t,
+        "decode_steps": steps,
+        "prefill_ms": round(prefill_s * 1e3, 2),
+        "e2e_ms": round(gen_s * 1e3, 2),
+        "decode_tokens_per_s": round(batch * (steps - 1) / decode_s, 1),
+        "prefill_tokens_per_s": round(batch * prompt_t / prefill_s, 1),
+    }
+
+
 def run_model_bench(steps: int = 12) -> dict:
     """Flagship-model step-time/MFU on the default backend (one chip)."""
     import jax
@@ -224,6 +273,9 @@ def run_model_bench(steps: int = 12) -> dict:
         # MFU figure in BASELINE.md describe one configuration
         "attention": _attention_bench(
             batch, cfg.n_heads, seq, cfg.head_dim, cfg.jdtype, on_tpu),
+        # serving-side numbers on the just-trained params: prefill
+        # latency + scanned KV-cache greedy decode throughput
+        "serving": _serving_bench(cfg, params, on_tpu),
     }
     return out
 

@@ -83,7 +83,16 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     api, server, agent = build_agent(args)
-    agent.register()
+    backoff = args.tick
+    while True:   # registration retries too: the apiserver may still be
+        try:      # coming up when the daemon starts (concurrent boot)
+            agent.register()
+            break
+        except (OSError, ValueError, Conflict) as e:
+            print(f"crishim: cannot register with {args.apiserver}, "
+                  f"retrying in {backoff:.1f}s: {e}", file=sys.stderr)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 10.0)
     print(f"crishim: node {agent.node_name} registered; "
           f"CRI socket {server.socket_path}", file=sys.stderr)
 

@@ -400,6 +400,12 @@ class HttpApiClient:
                 if stop.wait(0.2):
                     break
                 continue
+            if stop.is_set():
+                # our generation was stopped while the poll was in
+                # flight: a NEWER generation (with its own fresh tail)
+                # may own the watcher list now — delivering this batch
+                # would replay pre-subscription events to it, twice
+                break
             if out.get("reset"):
                 since = out["next"]   # lagged: skip ahead (caller relists)
                 continue

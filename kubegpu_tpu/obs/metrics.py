@@ -87,5 +87,43 @@ class MetricsRegistry:
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
 
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (the observability surface a
+        k8s-era deployment scrapes; served at GET /metrics on the
+        extender webhook).  Histograms export as summaries with
+        p50/p90/p99 quantiles plus _count and _sum.  A name registered
+        as BOTH gauge and histogram (harvest_workload_metrics does
+        this) exports the gauge as ``<name>_last`` — a duplicate metric
+        family is a hard parse error that would fail the whole scrape.
+        One locked pass, reusing _Histogram's own percentile math."""
+        def sanitize(name: str) -> str:
+            return "kubetpu_" + "".join(
+                c if c.isalnum() or c == "_" else "_" for c in name)
+
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hist_names = set(self._hists)
+            hist_stats = [
+                (k, h.percentile(50), h.percentile(90), h.percentile(99),
+                 h.count, h.mean * h.count)
+                for k, h in sorted(self._hists.items())]
+        lines: list[str] = []
+        for name, v in counters:
+            m = sanitize(name)
+            lines += [f"# TYPE {m} counter", f"{m} {v}"]
+        for name, v in gauges:
+            m = sanitize(name + "_last" if name in hist_names else name)
+            lines += [f"# TYPE {m} gauge", f"{m} {v}"]
+        for name, p50, p90, p99, n, total in hist_stats:
+            m = sanitize(name)
+            lines.append(f"# TYPE {m} summary")
+            lines.append(f'{m}{{quantile="0.5"}} {p50}')
+            lines.append(f'{m}{{quantile="0.9"}} {p90}')
+            lines.append(f'{m}{{quantile="0.99"}} {p99}')
+            lines.append(f"{m}_count {n}")
+            lines.append(f"{m}_sum {total}")
+        return "\n".join(lines) + "\n"
+
 
 global_registry = MetricsRegistry()

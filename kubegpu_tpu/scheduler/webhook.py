@@ -156,6 +156,22 @@ class ExtenderHTTPServer:
             def log_message(self, *a):  # quiet; we log structured below
                 pass
 
+            def do_GET(self) -> None:
+                if self.path != "/metrics":
+                    self.send_error(404, f"unknown path {self.path}")
+                    return
+                # Prometheus scrape surface: the schedule-latency
+                # summary here IS north-star metric #1
+                reg = getattr(scheduler, "metrics", None)
+                body = (reg.to_prometheus() if reg is not None
+                        else "").encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self) -> None:
                 try:
                     n = int(self.headers.get("Content-Length", 0))

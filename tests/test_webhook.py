@@ -380,3 +380,44 @@ class TestBindVerb:
         finally:
             srv.close()
             cl.close()
+
+
+class TestMetricsEndpoint:
+    def test_prometheus_scrape(self, cluster_and_server):
+        """GET /metrics serves Prometheus text with the schedule-latency
+        summary (north-star #1) after real decisions."""
+        cl, srv = cluster_and_server
+        cl.submit(tpu_pod("p", chips=1, command=["x"]))
+        cl.step()
+        req = urllib.request.Request(f"{srv.address}/metrics")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "# TYPE kubetpu_schedule_latency_ms summary" in body
+        assert 'kubetpu_schedule_latency_ms{quantile="0.5"}' in body
+        assert "kubetpu_schedule_latency_ms_count 1" in body
+        assert "# TYPE kubetpu_gangs_scheduled counter" in body
+
+    def test_unknown_get_404(self, cluster_and_server):
+        cl, srv = cluster_and_server
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{srv.address}/nope", timeout=10)
+        assert ei.value.code == 404
+
+    def test_gauge_histogram_name_collision_exports_cleanly(self):
+        """harvest_workload_metrics records the same name as gauge AND
+        histogram; the exposition must not emit a duplicate metric
+        family (a hard Prometheus parse error that would fail the whole
+        scrape)."""
+        from kubegpu_tpu.obs import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.observe("workload_bw", 12.5)
+        reg.set_gauge("workload_bw", 12.5)
+        reg.inc("jobs")
+        text = reg.to_prometheus()
+        families = [ln.split()[2] for ln in text.splitlines()
+                    if ln.startswith("# TYPE")]
+        assert len(families) == len(set(families)), families
+        assert "# TYPE kubetpu_workload_bw_last gauge" in text
+        assert "# TYPE kubetpu_workload_bw summary" in text
