@@ -204,6 +204,17 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
         lambda: greedy_generate(params, prompt, steps, cfg, max_len),
         lambda o: o, iters)
     decode_s = max(gen_s - prefill_s, 1e-9)
+    # int8 weight-only serving (models/quant.py): decode is weight-read
+    # bound, so halved weight bytes should show up directly
+    from kubegpu_tpu.models.quant import quantize_llama
+    qparams = quantize_llama(params)
+    # subtract the INT8 prefill, not the bf16 one — the dequant-epilogue
+    # prefill differs by tens of ms and must not be booked to decode
+    qprefill_s = timeit(lambda: pf(qparams, prompt), lambda o: o, iters)
+    qgen_s = timeit(
+        lambda: greedy_generate(qparams, prompt, steps, cfg, max_len),
+        lambda o: o, iters)
+    qdecode_s = max(qgen_s - qprefill_s, 1e-9)
     return {
         "batch": batch,
         "prompt_len": prompt_t,
@@ -212,6 +223,9 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
         "e2e_ms": round(gen_s * 1e3, 2),
         "decode_tokens_per_s": round(batch * (steps - 1) / decode_s, 1),
         "prefill_tokens_per_s": round(batch * prompt_t / prefill_s, 1),
+        "int8_decode_tokens_per_s": round(
+            batch * (steps - 1) / qdecode_s, 1),
+        "int8_decode_speedup": round(decode_s / qdecode_s, 2),
     }
 
 

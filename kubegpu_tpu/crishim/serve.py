@@ -88,7 +88,7 @@ def main(argv: list[str] | None = None) -> int:
         try:      # coming up when the daemon starts (concurrent boot)
             agent.register()
             break
-        except (OSError, ValueError, Conflict) as e:
+        except (OSError, ValueError, Conflict, NotFound) as e:
             print(f"crishim: cannot register with {args.apiserver}, "
                   f"retrying in {backoff:.1f}s: {e}", file=sys.stderr)
             time.sleep(backoff)

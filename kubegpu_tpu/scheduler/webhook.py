@@ -157,7 +157,7 @@ class ExtenderHTTPServer:
                 pass
 
             def do_GET(self) -> None:
-                if self.path != "/metrics":
+                if self.path.split("?", 1)[0] != "/metrics":
                     self.send_error(404, f"unknown path {self.path}")
                     return
                 # Prometheus scrape surface: the schedule-latency
