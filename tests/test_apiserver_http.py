@@ -288,6 +288,8 @@ class TestDaemonProcess:
             deadline = time.monotonic() + 30
             node_name = None
             while time.monotonic() < deadline and node_name is None:
+                assert proc.poll() is None, (
+                    "daemon died at startup:\n" + proc.stderr.read())
                 nodes = api.list("Node")
                 if nodes:
                     node_name = nodes[0].name
@@ -316,3 +318,57 @@ class TestDaemonProcess:
             except subprocess.TimeoutExpired:
                 proc.kill()
             srv.close()
+
+    @pytest.mark.slow
+    def test_daemon_survives_apiserver_restart(self, tmp_path):
+        """Control-plane restart with wiped state: the daemon must back
+        off, re-register its Node, and keep serving — not die (the
+        kubelet contract the retry loop implements)."""
+        import socket
+        import subprocess
+        import sys as _sys
+
+        # pre-pick a port so the restarted apiserver can reuse it
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        api1 = FakeApiServer()
+        srv1 = ApiServerHTTP(api1, port=port).start()
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "kubegpu_tpu.crishim.serve",
+             "--apiserver", f"http://127.0.0.1:{port}",
+             "--backend", "mock", "--slice", "v4-8",
+             "--cri-socket", str(tmp_path / "cri.sock"),
+             "--tick", "0.05", "--advertise-interval", "0.2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not api1.list("Node"):
+                assert proc.poll() is None, (
+                    "daemon died at startup:\n" + proc.stderr.read())
+                time.sleep(0.1)
+            assert api1.list("Node"), "daemon never registered"
+
+            srv1.close()   # apiserver dies; daemon starts erroring
+            time.sleep(0.5)
+            api2 = FakeApiServer()   # fresh state: Node object is GONE
+            srv2 = ApiServerHTTP(api2, port=port).start()
+            try:
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline and not api2.list("Node"):
+                    assert proc.poll() is None, (
+                        "daemon died during apiserver outage:\n"
+                        + proc.stderr.read())
+                    time.sleep(0.1)
+                assert api2.list("Node"), \
+                    "daemon never re-registered after restart"
+            finally:
+                srv2.close()
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
