@@ -192,40 +192,56 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
         out = fn()
         _fetch_scalar(fetch(out))
         rtt = _fetch_rtt_s(fetch(out))
-        t0 = time.perf_counter()
-        for _ in range(n):
-            out = fn()
-        _fetch_scalar(fetch(out))
-        return max(time.perf_counter() - t0 - rtt, 1e-9) / n
+        best = float("inf")
+        for _ in range(2):   # best-of-2: tunnel noise only ever adds
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn()
+            _fetch_scalar(fetch(out))
+            best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
+        return best / n
 
     pf = jax.jit(lambda p, t: prefill(p, t, cfg, max_len)[0])
-    prefill_s = timeit(lambda: pf(params, prompt), lambda o: o, iters)
-    gen_s = timeit(
-        lambda: greedy_generate(params, prompt, steps, cfg, max_len),
-        lambda o: o, iters)
-    decode_s = max(gen_s - prefill_s, 1e-9)
+
+    def measure(p, b, n):
+        """(prefill_s, decode_s) for params ``p`` at batch ``b`` — ONE
+        timing protocol for every configuration reported below, so the
+        batch-32 methodology cannot diverge from the batch-8 one.  The
+        prefill subtracted is always the SAME params' prefill (an int8
+        dequant-epilogue prefill differs by tens of ms and must not be
+        booked to decode)."""
+        pr = jnp.asarray(
+            np.arange(b * prompt_t).reshape(b, prompt_t)
+            % cfg.vocab_size, jnp.int32)
+        pre_s = timeit(lambda: pf(p, pr), lambda o: o, n)
+        gen_s = timeit(
+            lambda: greedy_generate(p, pr, steps, cfg, max_len),
+            lambda o: o, n)
+        return pre_s, max(gen_s - pre_s, 1e-9), gen_s
+
+    def tps(b, decode_s):
+        return round(b * (steps - 1) / decode_s, 1)
+
+    prefill_s, decode_s, gen_s = measure(params, batch, iters)
     # int8 weight-only serving (models/quant.py): decode is weight-read
-    # bound, so halved weight bytes should show up directly
+    # bound, so halved weight bytes show up directly
     from kubegpu_tpu.models.quant import quantize_llama
     qparams = quantize_llama(params)
-    # subtract the INT8 prefill, not the bf16 one — the dequant-epilogue
-    # prefill differs by tens of ms and must not be booked to decode
-    qprefill_s = timeit(lambda: pf(qparams, prompt), lambda o: o, iters)
-    qgen_s = timeit(
-        lambda: greedy_generate(qparams, prompt, steps, cfg, max_len),
-        lambda o: o, iters)
-    qdecode_s = max(qgen_s - qprefill_s, 1e-9)
+    _, qdecode_s, _ = measure(qparams, batch, iters)
+    # throughput-optimal serving runs wider batches than the
+    # latency-oriented headline
+    _, qdecode_b4x_s, _ = measure(qparams, batch * 4, max(iters - 1, 1))
     return {
         "batch": batch,
         "prompt_len": prompt_t,
         "decode_steps": steps,
         "prefill_ms": round(prefill_s * 1e3, 2),
         "e2e_ms": round(gen_s * 1e3, 2),
-        "decode_tokens_per_s": round(batch * (steps - 1) / decode_s, 1),
+        "decode_tokens_per_s": tps(batch, decode_s),
         "prefill_tokens_per_s": round(batch * prompt_t / prefill_s, 1),
-        "int8_decode_tokens_per_s": round(
-            batch * (steps - 1) / qdecode_s, 1),
+        "int8_decode_tokens_per_s": tps(batch, qdecode_s),
         "int8_decode_speedup": round(decode_s / qdecode_s, 2),
+        "int8_decode_b4x_tokens_per_s": tps(batch * 4, qdecode_b4x_s),
     }
 
 

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from kubegpu_tpu.models.llama import LlamaConfig, _rmsnorm, _rope
-from kubegpu_tpu.ops.flash_attention import NEG_INF, repeat_kv
+from kubegpu_tpu.ops.flash_attention import NEG_INF
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int,
@@ -43,17 +43,27 @@ def _cached_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
                    q_pos: jax.Array) -> jax.Array:
     """q: [B, Hq, T, D]; cache k/v: [B, Hkv, S, D]; q_pos: [T] global
     positions.  Masks ``k_pos > q_pos`` — causality and the unwritten
-    tail of the cache in one predicate."""
-    k, v = repeat_kv(q, ck, cv)
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bhtd,bhsd->bhts", q, k,
+    tail of the cache in one predicate.
+
+    GQA runs grouped, NOT via repeat_kv: decode is cache-read bound,
+    and materializing Hq/Hkv head-repeated (and f32-upcast) copies of
+    the whole cache per step multiplied the HBM traffic by up to 8x —
+    measured 7x slower at batch 32.  The grouped einsum reads each
+    cache element once, in its stored dtype, with f32 accumulation."""
+    b, hq, t, d = q.shape
+    hkv, s = ck.shape[1], ck.shape[2]
+    qg = q.reshape(b, hkv, hq // hkv, t, d)
+    scale = d ** -0.5
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, ck,
                         preferred_element_type=jnp.float32) * scale
-    k_pos = jnp.arange(k.shape[2])
-    scores = jnp.where((k_pos[None, :] <= q_pos[:, None])[None, None],
-                       scores, NEG_INF)
+    k_pos = jnp.arange(s)
+    scores = jnp.where(
+        (k_pos[None, :] <= q_pos[:, None])[None, None, None],
+        scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhts,bhsd->bhtd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bkgts,bksd->bkgtd", probs, cv,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, hq, t, d).astype(q.dtype)
 
 
 def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,

@@ -64,10 +64,14 @@ def check_sync_convergence(cl) -> None:
             assert got == expect, (sid, coord, got, expect)
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3])
-def test_control_plane_fuzz(seed):
+@pytest.mark.parametrize("seed,wire_cri", [(1, False), (2, False),
+                                           (3, False), (4, True)])
+def test_control_plane_fuzz(seed, wire_cri):
+    """Seed 4 runs the identical op mix with the CRI unix socket
+    spliced between every agent and its shim (wire_cri) — the wire
+    transport gets fuzz-level exercise, not just the happy-path tests."""
     rng = random.Random(seed)
-    cl = SimCluster(["v5e-16", "v4-8", "v4-8"])
+    cl = SimCluster(["v5e-16", "v4-8", "v4-8"], wire_cri=wire_cri)
     cl.set_quota("team-a", chips=10)   # one bounded tenant in the mix
     counter = 0
     hosts = [a.node_name for a in cl.agents]
