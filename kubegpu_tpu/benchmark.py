@@ -184,9 +184,6 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
     else:
         batch, prompt_t, steps, iters = 2, 8, 4, 2
     max_len = prompt_t + steps
-    prompt = jnp.asarray(
-        np.arange(batch * prompt_t).reshape(batch, prompt_t)
-        % cfg.vocab_size, jnp.int32)
 
     def timeit(fn, fetch, n):
         out = fn()
@@ -201,21 +198,22 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
             best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
         return best / n
 
-    pf = jax.jit(lambda p, t: prefill(p, t, cfg, max_len)[0])
-
-    def measure(p, b, n):
+    def measure(p, b, n, kv_int8=False):
         """(prefill_s, decode_s) for params ``p`` at batch ``b`` — ONE
         timing protocol for every configuration reported below, so the
         batch-32 methodology cannot diverge from the batch-8 one.  The
-        prefill subtracted is always the SAME params' prefill (an int8
-        dequant-epilogue prefill differs by tens of ms and must not be
-        booked to decode)."""
+        prefill subtracted is always the SAME configuration's prefill
+        (an int8 dequant-epilogue or int8-cache prefill differs by tens
+        of ms and must not be booked to decode)."""
+        pf = jax.jit(lambda pp, tk: prefill(
+            pp, tk, cfg, max_len, kv_int8=kv_int8)[0])
         pr = jnp.asarray(
             np.arange(b * prompt_t).reshape(b, prompt_t)
             % cfg.vocab_size, jnp.int32)
         pre_s = timeit(lambda: pf(p, pr), lambda o: o, n)
         gen_s = timeit(
-            lambda: greedy_generate(p, pr, steps, cfg, max_len),
+            lambda: greedy_generate(p, pr, steps, cfg, max_len,
+                                    kv_int8=kv_int8),
             lambda o: o, n)
         return pre_s, max(gen_s - pre_s, 1e-9), gen_s
 
@@ -228,9 +226,11 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
     from kubegpu_tpu.models.quant import quantize_llama
     qparams = quantize_llama(params)
     _, qdecode_s, _ = measure(qparams, batch, iters)
-    # throughput-optimal serving runs wider batches than the
-    # latency-oriented headline
-    _, qdecode_b4x_s, _ = measure(qparams, batch * 4, max(iters - 1, 1))
+    # + int8 KV cache: at wide batches the cache out-reads the weights
+    _, qkv_decode_s, _ = measure(qparams, batch, iters, kv_int8=True)
+    # throughput-optimal serving: wider batch, both quantizations on
+    _, qkv_b4x_s, _ = measure(qparams, batch * 4, max(iters - 1, 1),
+                              kv_int8=True)
     return {
         "batch": batch,
         "prompt_len": prompt_t,
@@ -241,7 +241,8 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
         "prefill_tokens_per_s": round(batch * prompt_t / prefill_s, 1),
         "int8_decode_tokens_per_s": tps(batch, qdecode_s),
         "int8_decode_speedup": round(decode_s / qdecode_s, 2),
-        "int8_decode_b4x_tokens_per_s": tps(batch * 4, qdecode_b4x_s),
+        "int8_kv_decode_tokens_per_s": tps(batch, qkv_decode_s),
+        "int8_kv_decode_b4x_tokens_per_s": tps(batch * 4, qkv_b4x_s),
     }
 
 

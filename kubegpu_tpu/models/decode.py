@@ -31,12 +31,34 @@ from kubegpu_tpu.ops.flash_attention import NEG_INF
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int,
-                  max_len: int | None = None) -> dict:
-    """Zeroed stacked cache; ``max_len`` defaults to cfg.max_seq_len."""
+                  max_len: int | None = None,
+                  kv_int8: bool = False) -> dict:
+    """Zeroed stacked cache; ``max_len`` defaults to cfg.max_seq_len.
+
+    ``kv_int8`` stores K/V as int8 with per-(layer, batch, head, token)
+    f32 scales: at wide serving batches the cache out-reads even int8
+    weights, so halving cache bytes is the next decode lever.  Scales
+    init to 1 so unwritten slots dequantize to exact zero."""
     s = max_len or cfg.max_seq_len
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, s, cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.jdtype),
-            "v": jnp.zeros(shape, cfg.jdtype)}
+    if not kv_int8:
+        return {"k": jnp.zeros(shape, cfg.jdtype),
+                "v": jnp.zeros(shape, cfg.jdtype)}
+    sshape = shape[:-1]
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.ones(sshape, jnp.float32),
+            "v_scale": jnp.ones(sshape, jnp.float32)}
+
+
+def _quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(batch, head, token) symmetric int8 over the channel dim.
+    x: [B, H, T, D] → (int8 values, f32 scales [B, H, T])."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
 
 
 def _cached_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
@@ -66,6 +88,34 @@ def _cached_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
     return out.reshape(b, hq, t, d).astype(q.dtype)
 
 
+def _cached_attend_q8(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                      k_scale: jax.Array, v_scale: jax.Array,
+                      q_pos: jax.Array) -> jax.Array:
+    """int8-cache variant of :func:`_cached_attend`: cache values are
+    int8 [B, Hkv, S, D] with f32 per-token scales [B, Hkv, S].  The
+    scales fold into the score matrix (k) and the probability matrix
+    (v) — the cache itself streams from HBM as int8, which is the whole
+    point; no dequantized copy is ever materialized."""
+    b, hq, t, d = q.shape
+    hkv, s = ck.shape[1], ck.shape[2]
+    qg = q.reshape(b, hkv, hq // hkv, t, d)
+    scale = d ** -0.5
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg,
+                        ck.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+    scores = scores * (scale * k_scale[:, :, None, None, :])
+    k_pos = jnp.arange(s)
+    scores = jnp.where(
+        (k_pos[None, :] <= q_pos[:, None])[None, None, None],
+        scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bksd->bkgtd",
+                     probs * v_scale[:, :, None, None, :],
+                     cv.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, hq, t, d).astype(q.dtype)
+
+
 def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
                         pos_offset: jax.Array, cfg: LlamaConfig
                         ) -> tuple[jax.Array, dict]:
@@ -75,45 +125,75 @@ def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
     T=1 for decode — same code path, same executable shape per T."""
     b, t = tokens.shape
     hd = cfg.head_dim
+    kv_int8 = "k_scale" in cache
     x = jnp.take(params["embed"], tokens, axis=0)
     q_pos = pos_offset + jnp.arange(t)
     positions = jnp.broadcast_to(q_pos[None, :], (b, t))
 
-    def layer(x, xs):
-        lp, ck, cv = xs
-        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    def project_kv(h, lp):
         q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, hd)
         k = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
         v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        # write the new K/V rows at pos_offset (cache is [B, Hkv, S, D])
-        ck = lax.dynamic_update_slice(
-            ck, k.transpose(0, 2, 1, 3).astype(ck.dtype),
-            (0, 0, pos_offset, 0))
-        cv = lax.dynamic_update_slice(
-            cv, v.transpose(0, 2, 1, 3).astype(cv.dtype),
-            (0, 0, pos_offset, 0))
-        o = _cached_attend(q.transpose(0, 2, 1, 3), ck, cv, q_pos)
+        return (q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3))   # [B, H, T, D]
+
+    def finish(x, o, lp):
         o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
         x = x + (o @ lp["wo"]).astype(x.dtype)
         h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-        x = x + (up @ lp["w_down"]).astype(x.dtype)
-        return x, (ck, cv)
+        return x + (up @ lp["w_down"]).astype(x.dtype)
 
-    x, (ck_new, cv_new) = lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"]))
+    if kv_int8:
+        def layer(x, xs):
+            lp, ck, cv, ks, vs = xs
+            h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+            q, k, v = project_kv(h, lp)
+            kq, kscale = _quantize_rows(k)
+            vq, vscale = _quantize_rows(v)
+            ck = lax.dynamic_update_slice(ck, kq, (0, 0, pos_offset, 0))
+            cv = lax.dynamic_update_slice(cv, vq, (0, 0, pos_offset, 0))
+            ks = lax.dynamic_update_slice(ks, kscale, (0, 0, pos_offset))
+            vs = lax.dynamic_update_slice(vs, vscale, (0, 0, pos_offset))
+            o = _cached_attend_q8(q, ck, cv, ks, vs, q_pos)
+            return finish(x, o, lp), (ck, cv, ks, vs)
+
+        x, (ck_new, cv_new, ks_new, vs_new) = lax.scan(
+            layer, x,
+            (params["layers"], cache["k"], cache["v"],
+             cache["k_scale"], cache["v_scale"]))
+        new_cache = {"k": ck_new, "v": cv_new,
+                     "k_scale": ks_new, "v_scale": vs_new}
+    else:
+        def layer(x, xs):
+            lp, ck, cv = xs
+            h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+            q, k, v = project_kv(h, lp)
+            # write the new K/V rows at pos_offset ([B, Hkv, S, D])
+            ck = lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, 0, pos_offset, 0))
+            cv = lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, 0, pos_offset, 0))
+            o = _cached_attend(q, ck, cv, q_pos)
+            return finish(x, o, lp), (ck, cv)
+
+        x, (ck_new, cv_new) = lax.scan(
+            layer, x, (params["layers"], cache["k"], cache["v"]))
+        new_cache = {"k": ck_new, "v": cv_new}
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": ck_new, "v": cv_new}
+    return logits, new_cache
 
 
 def prefill(params: dict, prompt: jax.Array, cfg: LlamaConfig,
-            max_len: int | None = None) -> tuple[jax.Array, dict]:
+            max_len: int | None = None,
+            kv_int8: bool = False) -> tuple[jax.Array, dict]:
     """Process the whole prompt [B, T]; returns (last-position logits
     [B, vocab], primed cache)."""
-    cache = init_kv_cache(cfg, prompt.shape[0], max_len)
+    cache = init_kv_cache(cfg, prompt.shape[0], max_len,
+                          kv_int8=kv_int8)
     logits, cache = _forward_with_cache(
         params, prompt, cache, jnp.int32(0), cfg)
     return logits[:, -1], cache
@@ -130,7 +210,8 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
 
 
 @functools.lru_cache(maxsize=64)
-def _generate_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int):
+def _generate_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
+                 kv_int8: bool = False):
     """One compiled executable per (config, prompt len, steps, cache len)
     — repeat generations with the same shapes hit XLA's cache instead of
     re-tracing (the jit cache is keyed on the function object, so it must
@@ -138,7 +219,8 @@ def _generate_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int):
 
     @jax.jit
     def run(params, prompt):
-        logits, cache = prefill(params, prompt, cfg, max_len)
+        logits, cache = prefill(params, prompt, cfg, max_len,
+                                kv_int8=kv_int8)
         first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
 
         def step(carry, i):
@@ -159,14 +241,17 @@ def _generate_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int):
 
 def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
                     cfg: LlamaConfig,
-                    max_len: int | None = None) -> jax.Array:
+                    max_len: int | None = None,
+                    kv_int8: bool = False) -> jax.Array:
     """Greedy decode ``n_steps`` tokens after ``prompt`` [B, T] — prefill
     plus one scanned decode loop, all inside a single jit.  Returns the
-    generated tokens [B, n_steps]."""
+    generated tokens [B, n_steps].  ``kv_int8`` stores the cache as
+    int8 with per-token scales (half the cache HBM traffic — the
+    dominant decode cost at wide batches)."""
     max_len = max_len or cfg.max_seq_len
     t = prompt.shape[1]
     if n_steps < 1:
         raise ValueError(f"n_steps must be >= 1, got {n_steps}")
     if t + n_steps > max_len:
         raise ValueError(f"prompt {t} + steps {n_steps} > max_len {max_len}")
-    return _generate_fn(cfg, t, n_steps, max_len)(params, prompt)
+    return _generate_fn(cfg, t, n_steps, max_len, kv_int8)(params, prompt)

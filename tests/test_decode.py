@@ -78,3 +78,47 @@ class TestPrefillDecode:
         prompt = jnp.zeros((1, 60), jnp.int32)
         with pytest.raises(ValueError, match="max_len"):
             greedy_generate(params, prompt, 10, cfg)
+
+
+class TestKvInt8:
+    def test_prefill_logits_close_to_bf16_cache(self, tiny):
+        """int8 cache with per-token scales: last-position logits must
+        track the exact-cache path closely (8-bit symmetric round-off
+        only)."""
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 9, dtype=jnp.int32).reshape(2, 9) * 7
+                  ) % cfg.vocab_size
+        ref, _ = prefill(params, prompt, cfg)
+        got, cache = prefill(params, prompt, cfg, kv_int8=True)
+        assert cache["k"].dtype == jnp.int8
+        assert cache["k_scale"].shape == cache["k"].shape[:-1]
+        err = np.max(np.abs(np.asarray(got) - np.asarray(ref)))
+        ref_mag = np.max(np.abs(np.asarray(ref)))
+        assert err < 0.02 * max(ref_mag, 1.0), (err, ref_mag)
+
+    def test_decode_step_consumes_quantized_cache(self, tiny):
+        cfg, params = tiny
+        seq = (jnp.arange(12, dtype=jnp.int32)[None, :] * 5
+               ) % cfg.vocab_size
+        ref = llama_forward(params, seq, cfg)
+        logits, cache = prefill(params, seq[:, :4], cfg, kv_int8=True)
+        for pos in range(4, 8):
+            logits, cache = decode_step(params, cache, seq[:, pos],
+                                        pos, cfg)
+            # loose: int8 cache round-off accumulates over positions
+            err = np.max(np.abs(np.asarray(logits)
+                                - np.asarray(ref[:, pos])))
+            assert err < 0.05 * max(
+                float(np.max(np.abs(np.asarray(ref[:, pos])))), 1.0)
+
+    def test_greedy_generate_kv_int8_tokens_mostly_agree(self, tiny):
+        """Token-level agreement with the exact cache on a tiny model —
+        argmax can legitimately flip on near-ties, so require majority
+        agreement, not identity."""
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5) * 3
+                  ) % cfg.vocab_size
+        exact = np.asarray(greedy_generate(params, prompt, 6, cfg))
+        quant = np.asarray(greedy_generate(params, prompt, 6, cfg,
+                                           kv_int8=True))
+        assert (exact == quant).mean() >= 0.5, (exact, quant)
