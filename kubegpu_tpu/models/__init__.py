@@ -7,6 +7,7 @@ from kubegpu_tpu.models.decode import (
     decode_step,
     greedy_generate,
     init_kv_cache,
+    sample_generate,
     prefill,
 )
 from kubegpu_tpu.models.llama import (
@@ -34,5 +35,6 @@ __all__ = [
     "MoEConfig", "moe_forward", "moe_init", "moe_param_specs",
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
+    "sample_generate",
     "QTensor", "quantize_llama",
 ]

@@ -219,24 +219,119 @@ def _generate_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
 
     @jax.jit
     def run(params, prompt):
-        logits, cache = prefill(params, prompt, cfg, max_len,
-                                kv_int8=kv_int8)
-        first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-
-        def step(carry, i):
-            token, cache = carry
-            logits, cache = decode_step(params, cache, token, t + i, cfg)
-            nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
-            return (nxt, cache), nxt
-
-        # n_steps - 1 decode forwards: the prefill already produced the
-        # first token, and the last token needs no successor logits
-        (_, _), rest = lax.scan(
-            step, (first, cache), jnp.arange(n_steps - 1))
-        toks = jnp.concatenate([first[None], rest], axis=0)
-        return toks.swapaxes(0, 1)   # [B, n_steps]
+        return _rollout(params, prompt, cfg, t, n_steps, max_len,
+                        kv_int8,
+                        pick=lambda logits, i: jnp.argmax(logits, -1))
 
     return run
+
+
+def _nucleus_mask(sorted_l: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Given DESC-sorted logits, NEG_INF-mask everything outside the
+    smallest prefix whose EXCLUSIVE cumulative probability is < top_p
+    (always keeps >= 1 token; top_p >= 1 keeps everything)."""
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs
+    return jnp.where(cum_excl < top_p, sorted_l, NEG_INF)
+
+
+def _sample_token(logits: jax.Array, key: jax.Array,
+                  temperature: jax.Array, top_p: jax.Array,
+                  top_k: int) -> jax.Array:
+    """One sampling step over [B, V] f32 logits — temperature scaling,
+    static top-k truncation, dynamic top-p (nucleus) truncation, then a
+    categorical draw.  Cost matters in the scanned decode loop: with
+    top_k set, the sort (and the nucleus inside it) runs over only k
+    elements; with neither truncation, no sort happens at all.  A pure
+    top_p (top_k=0) needs the full-vocab sort — measured ~3x the decode
+    step on v5e at V=32k, so serving configs should set top_k too."""
+    l = logits / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        vals, idx = lax.top_k(l, top_k)           # [B, k] desc
+        vals = _nucleus_mask(vals, top_p)
+        choice = jax.random.categorical(key, vals, axis=-1)   # [B]
+        return jnp.take_along_axis(idx, choice[:, None], 1)[:, 0]
+    # exact full-vocab nucleus; skipped entirely when top_p >= 1 would
+    # not be traceable (top_p is dynamic), so the sort always runs here
+    sorted_l, sorted_idx = lax.top_k(l, l.shape[-1])
+    masked = _nucleus_mask(sorted_l, top_p)
+    choice = jax.random.categorical(key, masked, axis=-1)
+    return jnp.take_along_axis(sorted_idx, choice[:, None], 1)[:, 0]
+
+
+def _rollout(params, prompt, cfg: LlamaConfig, t: int, n_steps: int,
+             max_len: int, kv_int8: bool, pick):
+    """THE decode loop — prefill, then ``n_steps - 1`` scanned decode
+    forwards (the prefill already yields the first token's logits, the
+    last token needs no successor) — shared by greedy and sampled
+    generation so the position bookkeeping and cache threading can
+    never diverge between them.  ``pick(logits, step_index)`` is the
+    trace-time-static token-selection rule."""
+    logits, cache = prefill(params, prompt, cfg, max_len,
+                            kv_int8=kv_int8)
+    first = pick(logits, 0).astype(prompt.dtype)
+
+    def step(carry, i):
+        token, cache = carry
+        logits, cache = decode_step(params, cache, token, t + i, cfg)
+        nxt = pick(logits, i + 1).astype(token.dtype)
+        return (nxt, cache), nxt
+
+    (_, _), rest = lax.scan(step, (first, cache),
+                            jnp.arange(n_steps - 1))
+    toks = jnp.concatenate([first[None], rest], axis=0)
+    return toks.swapaxes(0, 1)
+
+
+@functools.lru_cache(maxsize=64)
+def _sample_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
+               top_k: int, kv_int8: bool):
+    """Compiled sampled-generation executable per static signature
+    (temperature/top_p stay dynamic args — no recompile per setting)."""
+
+    @jax.jit
+    def run(params, prompt, key, temperature, top_p):
+        keys = jax.random.split(key, n_steps)
+
+        def pick(logits, i):
+            return _sample_token(logits, keys[i], temperature, top_p,
+                                 top_k)
+
+        return _rollout(params, prompt, cfg, t, n_steps, max_len,
+                        kv_int8, pick)
+
+    return run
+
+
+def sample_generate(params: dict, prompt: jax.Array, n_steps: int,
+                    cfg: LlamaConfig, key: jax.Array,
+                    temperature: float = 1.0, top_k: int = 0,
+                    top_p: float = 1.0, max_len: int | None = None,
+                    kv_int8: bool = False) -> jax.Array:
+    """Stochastic decode: temperature / top-k / top-p (nucleus)
+    sampling over the same scanned KV-cache loop as
+    :func:`greedy_generate`.  ``top_k=0`` disables the k-truncation;
+    ``top_p=1.0`` disables nucleus truncation; both together reduce to
+    plain temperature sampling.  Deterministic per ``key``."""
+    max_len = max_len or cfg.max_seq_len
+    t = prompt.shape[1]
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    if t + n_steps > max_len:
+        raise ValueError(f"prompt {t} + steps {n_steps} > max_len {max_len}")
+    if not 0 <= top_k <= cfg.vocab_size:
+        raise ValueError(f"top_k {top_k} not in [0, vocab]")
+    if not 0.0 < top_p:
+        # top_p <= 0 would mask EVERY token; the argmax that comes out
+        # is a float-absorption accident, not a contract — reject it
+        raise ValueError(f"top_p must be > 0, got {top_p}")
+    if temperature <= 0:
+        raise ValueError(
+            f"temperature must be > 0, got {temperature} "
+            "(use greedy_generate for argmax decoding)")
+    return _sample_fn(cfg, t, n_steps, max_len, int(top_k), kv_int8)(
+        params, prompt, key,
+        jnp.float32(temperature), jnp.float32(top_p))
 
 
 def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,

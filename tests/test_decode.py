@@ -122,3 +122,84 @@ class TestKvInt8:
         quant = np.asarray(greedy_generate(params, prompt, 6, cfg,
                                            kv_int8=True))
         assert (exact == quant).mean() >= 0.5, (exact, quant)
+
+
+class TestSampling:
+    def test_near_zero_temperature_matches_greedy(self, tiny):
+        from kubegpu_tpu.models import sample_generate
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5) * 3
+                  ) % cfg.vocab_size
+        greedy = np.asarray(greedy_generate(params, prompt, 6, cfg))
+        sampled = np.asarray(sample_generate(
+            params, prompt, 6, cfg, jax.random.PRNGKey(0),
+            temperature=1e-5))
+        np.testing.assert_array_equal(sampled, greedy)
+
+    def test_top_k_one_matches_greedy(self, tiny):
+        from kubegpu_tpu.models import sample_generate
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5) * 3
+                  ) % cfg.vocab_size
+        greedy = np.asarray(greedy_generate(params, prompt, 6, cfg))
+        sampled = np.asarray(sample_generate(
+            params, prompt, 6, cfg, jax.random.PRNGKey(7), top_k=1,
+            temperature=5.0))   # high temp: only the k-mask saves us
+        np.testing.assert_array_equal(sampled, greedy)
+
+    def test_deterministic_per_key_and_varies_across_keys(self, tiny):
+        from kubegpu_tpu.models import sample_generate
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5) * 3
+                  ) % cfg.vocab_size
+        a1 = np.asarray(sample_generate(
+            params, prompt, 8, cfg, jax.random.PRNGKey(1),
+            temperature=2.0))
+        a2 = np.asarray(sample_generate(
+            params, prompt, 8, cfg, jax.random.PRNGKey(1),
+            temperature=2.0))
+        b = np.asarray(sample_generate(
+            params, prompt, 8, cfg, jax.random.PRNGKey(2),
+            temperature=2.0))
+        np.testing.assert_array_equal(a1, a2)
+        assert (a1 != b).any()   # hot sampling: keys must matter
+        assert (a1 >= 0).all() and (a1 < cfg.vocab_size).all()
+
+    def test_top_p_restricts_support(self, tiny):
+        """With a sharply peaked distribution (tiny top_p) sampling must
+        collapse to the argmax even at high temperature."""
+        from kubegpu_tpu.models import sample_generate
+        cfg, params = tiny
+        prompt = (jnp.arange(5, dtype=jnp.int32)[None] * 3
+                  ) % cfg.vocab_size
+        greedy = np.asarray(greedy_generate(params, prompt, 4, cfg))
+        for seed in range(3):
+            got = np.asarray(sample_generate(
+                params, prompt, 4, cfg, jax.random.PRNGKey(seed),
+                temperature=1.0, top_p=1e-6))
+            np.testing.assert_array_equal(got, greedy)
+
+    def test_kv_int8_sampling_runs(self, tiny):
+        from kubegpu_tpu.models import sample_generate
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5)
+                  ) % cfg.vocab_size
+        out = np.asarray(sample_generate(
+            params, prompt, 4, cfg, jax.random.PRNGKey(3),
+            temperature=0.8, top_k=8, top_p=0.9, kv_int8=True))
+        assert out.shape == (2, 4)
+        assert (out >= 0).all() and (out < cfg.vocab_size).all()
+
+    def test_degenerate_knobs_rejected(self, tiny):
+        from kubegpu_tpu.models import sample_generate
+        cfg, params = tiny
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="top_p"):
+            sample_generate(params, prompt, 2, cfg,
+                            jax.random.PRNGKey(0), top_p=0.0)
+        with pytest.raises(ValueError, match="temperature"):
+            sample_generate(params, prompt, 2, cfg,
+                            jax.random.PRNGKey(0), temperature=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            sample_generate(params, prompt, 2, cfg,
+                            jax.random.PRNGKey(0), top_k=-1)
