@@ -306,7 +306,10 @@ def run_model_bench(steps: int = 12) -> dict:
             batch, cfg.n_heads, seq, cfg.head_dim, cfg.jdtype, on_tpu),
         # serving-side numbers on the just-trained params: prefill
         # latency + scanned KV-cache greedy decode throughput
-        "serving": _serving_bench(cfg, params, on_tpu),
+        # (KUBETPU_BENCH_SERVING=0 skips — ~4 of bench.py's ~6.5 min)
+        "serving": (_serving_bench(cfg, params, on_tpu)
+                    if os.environ.get("KUBETPU_BENCH_SERVING", "1") != "0"
+                    else None),
     }
     return out
 

@@ -237,26 +237,42 @@ def _nucleus_mask(sorted_l: jax.Array, top_p: jax.Array) -> jax.Array:
 
 def _sample_token(logits: jax.Array, key: jax.Array,
                   temperature: jax.Array, top_p: jax.Array,
-                  top_k: int) -> jax.Array:
+                  top_k: int, nucleus: bool) -> jax.Array:
     """One sampling step over [B, V] f32 logits — temperature scaling,
     static top-k truncation, dynamic top-p (nucleus) truncation, then a
     categorical draw.  Cost matters in the scanned decode loop: with
     top_k set, the sort (and the nucleus inside it) runs over only k
-    elements; with neither truncation, no sort happens at all.  A pure
-    top_p (top_k=0) needs the full-vocab sort — measured ~3x the decode
-    step on v5e at V=32k, so serving configs should set top_k too."""
+    elements; with neither truncation (``nucleus=False``, the static
+    did-the-caller-pass-top_p<1 flag), no sort happens at all.  A pure
+    top_p (top_k=0, nucleus) needs the full-vocab sort — measured ~3x
+    the decode step on v5e at V=32k, so serving configs should set
+    top_k too."""
     l = logits / jnp.maximum(temperature, 1e-6)
     if top_k:
         vals, idx = lax.top_k(l, top_k)           # [B, k] desc
         vals = _nucleus_mask(vals, top_p)
         choice = jax.random.categorical(key, vals, axis=-1)   # [B]
         return jnp.take_along_axis(idx, choice[:, None], 1)[:, 0]
-    # exact full-vocab nucleus; skipped entirely when top_p >= 1 would
-    # not be traceable (top_p is dynamic), so the sort always runs here
+    if not nucleus:
+        return jax.random.categorical(key, l, axis=-1)
+    # exact full-vocab nucleus (top_k=0, top_p<1): needs the full sort
     sorted_l, sorted_idx = lax.top_k(l, l.shape[-1])
     masked = _nucleus_mask(sorted_l, top_p)
     choice = jax.random.categorical(key, masked, axis=-1)
     return jnp.take_along_axis(sorted_idx, choice[:, None], 1)[:, 0]
+
+
+def _validate_rollout(cfg: LlamaConfig, t: int, n_steps: int,
+                      max_len: int | None) -> int:
+    """Shared length contract for greedy and sampled generation —
+    returns the resolved max_len."""
+    max_len = max_len or cfg.max_seq_len
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    if t + n_steps > max_len:
+        raise ValueError(
+            f"prompt {t} + steps {n_steps} > max_len {max_len}")
+    return max_len
 
 
 def _rollout(params, prompt, cfg: LlamaConfig, t: int, n_steps: int,
@@ -285,9 +301,10 @@ def _rollout(params, prompt, cfg: LlamaConfig, t: int, n_steps: int,
 
 @functools.lru_cache(maxsize=64)
 def _sample_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
-               top_k: int, kv_int8: bool):
+               top_k: int, nucleus: bool, kv_int8: bool):
     """Compiled sampled-generation executable per static signature
-    (temperature/top_p stay dynamic args — no recompile per setting)."""
+    (temperature/top_p stay dynamic args — no recompile per setting;
+    ``nucleus`` is static so top_p=1.0 callers skip the sort)."""
 
     @jax.jit
     def run(params, prompt, key, temperature, top_p):
@@ -295,7 +312,7 @@ def _sample_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
 
         def pick(logits, i):
             return _sample_token(logits, keys[i], temperature, top_p,
-                                 top_k)
+                                 top_k, nucleus)
 
         return _rollout(params, prompt, cfg, t, n_steps, max_len,
                         kv_int8, pick)
@@ -312,13 +329,10 @@ def sample_generate(params: dict, prompt: jax.Array, n_steps: int,
     sampling over the same scanned KV-cache loop as
     :func:`greedy_generate`.  ``top_k=0`` disables the k-truncation;
     ``top_p=1.0`` disables nucleus truncation; both together reduce to
-    plain temperature sampling.  Deterministic per ``key``."""
-    max_len = max_len or cfg.max_seq_len
+    plain temperature sampling (no per-step sort at all).
+    Deterministic per ``key``."""
     t = prompt.shape[1]
-    if n_steps < 1:
-        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
-    if t + n_steps > max_len:
-        raise ValueError(f"prompt {t} + steps {n_steps} > max_len {max_len}")
+    max_len = _validate_rollout(cfg, t, n_steps, max_len)
     if not 0 <= top_k <= cfg.vocab_size:
         raise ValueError(f"top_k {top_k} not in [0, vocab]")
     if not 0.0 < top_p:
@@ -329,7 +343,8 @@ def sample_generate(params: dict, prompt: jax.Array, n_steps: int,
         raise ValueError(
             f"temperature must be > 0, got {temperature} "
             "(use greedy_generate for argmax decoding)")
-    return _sample_fn(cfg, t, n_steps, max_len, int(top_k), kv_int8)(
+    return _sample_fn(cfg, t, n_steps, max_len, int(top_k),
+                      float(top_p) < 1.0, kv_int8)(
         params, prompt, key,
         jnp.float32(temperature), jnp.float32(top_p))
 
@@ -343,10 +358,6 @@ def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
     generated tokens [B, n_steps].  ``kv_int8`` stores the cache as
     int8 with per-token scales (half the cache HBM traffic — the
     dominant decode cost at wide batches)."""
-    max_len = max_len or cfg.max_seq_len
     t = prompt.shape[1]
-    if n_steps < 1:
-        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
-    if t + n_steps > max_len:
-        raise ValueError(f"prompt {t} + steps {n_steps} > max_len {max_len}")
+    max_len = _validate_rollout(cfg, t, n_steps, max_len)
     return _generate_fn(cfg, t, n_steps, max_len, kv_int8)(params, prompt)
