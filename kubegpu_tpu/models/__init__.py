@@ -23,6 +23,12 @@ from kubegpu_tpu.models.moe import (
     moe_param_specs,
 )
 from kubegpu_tpu.models.quant import QTensor, quantize_llama
+from kubegpu_tpu.models.t5 import (
+    T5Config,
+    t5_forward,
+    t5_init,
+    t5_param_specs,
+)
 from kubegpu_tpu.models.vit import (
     ViTConfig,
     vit_forward,
@@ -33,6 +39,7 @@ from kubegpu_tpu.models.vit import (
 __all__ = [
     "LlamaConfig", "llama_forward", "llama_init", "llama_param_specs",
     "MoEConfig", "moe_forward", "moe_init", "moe_param_specs",
+    "T5Config", "t5_forward", "t5_init", "t5_param_specs",
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
     "sample_generate",

@@ -250,7 +250,8 @@ def _sample_token(logits: jax.Array, key: jax.Array,
     l = logits / jnp.maximum(temperature, 1e-6)
     if top_k:
         vals, idx = lax.top_k(l, top_k)           # [B, k] desc
-        vals = _nucleus_mask(vals, top_p)
+        if nucleus:   # static: top_p=1.0 callers skip the no-op mask
+            vals = _nucleus_mask(vals, top_p)
         choice = jax.random.categorical(key, vals, axis=-1)   # [B]
         return jnp.take_along_axis(idx, choice[:, None], 1)[:, 0]
     if not nucleus:

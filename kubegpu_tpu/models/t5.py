@@ -1,0 +1,335 @@
+"""T5-style encoder-decoder family (TPU-native addition).
+
+The third transformer family beyond decoder-only Llama and MoE:
+bidirectional encoder + causal decoder with cross-attention, T5's
+relative-position-bucket bias in place of rope, RMSNorm pre-norm, and
+the T5.1.1 gated-GELU feed-forward.  Same house style as
+:mod:`kubegpu_tpu.models.llama`: stacked-layer parameter pytrees
+scanned with ``lax.scan``, GSPMD sharding specs (megatron tp on
+heads/ffn, fsdp on the other dim), logical-sharding constraints so XLA
+places the collectives.
+
+Attention here is the XLA einsum path with an additive bias — the
+pallas flash kernel has no bias hook, and the encoder/decoder lengths
+of seq2seq workloads are short relative to the causal-LM bench; the
+kernel stays the decoder-only families' specialty.
+
+Reference note: the reference (SURVEY.md) is a scheduler with no model
+code; this family exists to exercise the framework's workload surface
+(encoder/decoder sharding, two-tower step) the way `example/` jobs
+exercised the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubegpu_tpu.models.llama import _rmsnorm
+from kubegpu_tpu.ops.flash_attention import NEG_INF
+from kubegpu_tpu.parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 768
+    n_enc_layers: int = 12
+    n_dec_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 2048
+    rel_buckets: int = 32
+    rel_max_dist: int = 128
+    norm_eps: float = 1e-6
+    dtype: str = "bfloat16"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def tiny(cls, **kw) -> "T5Config":
+        base = cls(vocab_size=256, d_model=64, n_enc_layers=2,
+                   n_dec_layers=2, n_heads=4, d_ff=128, rel_buckets=8,
+                   rel_max_dist=32, dtype="float32")
+        return replace(base, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Init + sharding specs
+# ---------------------------------------------------------------------------
+
+def t5_init(key: jax.Array, cfg: T5Config) -> dict:
+    hd = cfg.head_dim
+    proj = cfg.n_heads * hd
+
+    def norm_init(shape):
+        return jnp.ones(shape, cfg.jdtype)
+
+    def dense_init(k, shape, scale_dim):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (scale_dim ** -0.5)).astype(cfg.jdtype)
+
+    def attn_block(k, n, prefix):
+        ks = jax.random.split(k, 4)
+        return {
+            f"{prefix}q": dense_init(ks[0], (n, cfg.d_model, proj),
+                                     cfg.d_model),
+            f"{prefix}k": dense_init(ks[1], (n, cfg.d_model, proj),
+                                     cfg.d_model),
+            f"{prefix}v": dense_init(ks[2], (n, cfg.d_model, proj),
+                                     cfg.d_model),
+            f"{prefix}o": dense_init(ks[3], (n, proj, cfg.d_model), proj),
+        }
+
+    def ffn_block(k, n):
+        ks = jax.random.split(k, 3)
+        return {
+            "wi_0": dense_init(ks[0], (n, cfg.d_model, cfg.d_ff),
+                               cfg.d_model),
+            "wi_1": dense_init(ks[1], (n, cfg.d_model, cfg.d_ff),
+                               cfg.d_model),
+            "wo_ff": dense_init(ks[2], (n, cfg.d_ff, cfg.d_model),
+                                cfg.d_ff),
+        }
+
+    (k_emb, k_enc_a, k_enc_f, k_dec_s, k_dec_c, k_dec_f, k_out,
+     k_enc_rel, k_dec_rel) = jax.random.split(key, 9)
+    ne, nd = cfg.n_enc_layers, cfg.n_dec_layers
+    return {
+        "embed": dense_init(k_emb, (cfg.vocab_size, cfg.d_model),
+                            cfg.d_model),
+        # one shared bias table per stack ([buckets, H]), as in T5
+        "enc_rel": dense_init(k_enc_rel, (cfg.rel_buckets, cfg.n_heads),
+                              cfg.rel_buckets),
+        "dec_rel": dense_init(k_dec_rel, (cfg.rel_buckets, cfg.n_heads),
+                              cfg.rel_buckets),
+        "encoder": {
+            "attn_norm": norm_init((ne, cfg.d_model)),
+            **attn_block(k_enc_a, ne, "w"),
+            "mlp_norm": norm_init((ne, cfg.d_model)),
+            **ffn_block(k_enc_f, ne),
+        },
+        "decoder": {
+            "self_norm": norm_init((nd, cfg.d_model)),
+            **attn_block(k_dec_s, nd, "s"),
+            "cross_norm": norm_init((nd, cfg.d_model)),
+            **attn_block(k_dec_c, nd, "c"),
+            "mlp_norm": norm_init((nd, cfg.d_model)),
+            **ffn_block(k_dec_f, nd),
+        },
+        "enc_final_norm": norm_init((cfg.d_model,)),
+        "dec_final_norm": norm_init((cfg.d_model,)),
+        "lm_head": dense_init(k_out, (cfg.d_model, cfg.vocab_size),
+                              cfg.d_model),
+    }
+
+
+def t5_param_specs(cfg: T5Config) -> dict:
+    def attn_specs(prefix):
+        return {
+            f"{prefix}q": P(None, "fsdp", "tp"),
+            f"{prefix}k": P(None, "fsdp", "tp"),
+            f"{prefix}v": P(None, "fsdp", "tp"),
+            f"{prefix}o": P(None, "tp", "fsdp"),
+        }
+
+    ffn_specs = {
+        "wi_0": P(None, "fsdp", "tp"),
+        "wi_1": P(None, "fsdp", "tp"),
+        "wo_ff": P(None, "tp", "fsdp"),
+    }
+    return {
+        "embed": P("tp", "fsdp"),
+        "enc_rel": P(None, "tp"),
+        "dec_rel": P(None, "tp"),
+        "encoder": {
+            "attn_norm": P(None, None),
+            **attn_specs("w"),
+            "mlp_norm": P(None, None),
+            **ffn_specs,
+        },
+        "decoder": {
+            "self_norm": P(None, None),
+            **attn_specs("s"),
+            "cross_norm": P(None, None),
+            **attn_specs("c"),
+            "mlp_norm": P(None, None),
+            **ffn_specs,
+        },
+        "enc_final_norm": P(None),
+        "dec_final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Relative position bias (T5 bucketing)
+# ---------------------------------------------------------------------------
+
+def rel_pos_bucket(rel: jax.Array, bidirectional: bool,
+                   num_buckets: int, max_dist: int) -> jax.Array:
+    """T5's log-spaced relative-position bucketing.  ``rel`` is
+    memory_pos - query_pos.  Bidirectional splits the bucket space by
+    sign; causal buckets only the past (future clamps to bucket 0 but
+    is masked anyway)."""
+    ret = jnp.zeros_like(rel)
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (rel > 0).astype(rel.dtype) * num_buckets
+        n = jnp.abs(rel)
+    else:
+        n = jnp.maximum(-rel, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / jnp.log(max_dist / max_exact)
+        * (num_buckets - max_exact)).astype(rel.dtype)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def _rel_bias(table: jax.Array, t: int, s: int, bidirectional: bool,
+              cfg: T5Config) -> jax.Array:
+    """[H, T, S] additive attention bias from the [buckets, H] table."""
+    q_pos = jnp.arange(t)[:, None]
+    k_pos = jnp.arange(s)[None, :]
+    bucket = rel_pos_bucket(k_pos - q_pos, bidirectional,
+                            cfg.rel_buckets, cfg.rel_max_dist)
+    return jnp.take(table, bucket, axis=0).transpose(2, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _bias_attention(q, k, v, bias, causal: bool) -> jax.Array:
+    """q [B,T,H,D], k/v [B,S,H,D], bias [H,T,S] (or None) → [B,T,H,D].
+    f32 scores/softmax, additive bias before masking."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bthd,bshd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * d ** -0.5
+    if bias is not None:
+        scores = scores + bias[None].astype(jnp.float32)
+    if causal:
+        t, s = scores.shape[2], scores.shape[3]
+        mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _attn(h, x, lp, prefix, cfg, bias, causal, mesh, kv_src=None):
+    """Shared attention sublayer: norm'd input ``h`` projects q from
+    itself and k/v from ``kv_src`` (cross-attention) or itself."""
+    b, t = h.shape[0], h.shape[1]
+    hd = cfg.head_dim
+    src = h if kv_src is None else kv_src
+    s = src.shape[1]
+    q = (h @ lp[f"{prefix}q"]).reshape(b, t, cfg.n_heads, hd)
+    k = (src @ lp[f"{prefix}k"]).reshape(b, s, cfg.n_heads, hd)
+    v = (src @ lp[f"{prefix}v"]).reshape(b, s, cfg.n_heads, hd)
+    o = _bias_attention(q, k, v, bias, causal)
+    o = o.reshape(b, t, cfg.n_heads * hd)
+    o = constrain(o, mesh, ("dp", "fsdp"), None, "tp")
+    return x + (o @ lp[f"{prefix}o"]).astype(x.dtype)
+
+
+def _ffn(x, lp, cfg, mesh):
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    up = jax.nn.gelu(h @ lp["wi_0"]) * (h @ lp["wi_1"])
+    up = constrain(up, mesh, ("dp", "fsdp"), None, "tp")
+    return x + (up @ lp["wo_ff"]).astype(x.dtype)
+
+
+def t5_encode(params: dict, tokens: jax.Array, cfg: T5Config,
+              mesh: Mesh | None = None) -> jax.Array:
+    """tokens [B, S] → encoder states [B, S, d_model]."""
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = constrain(x, mesh, ("dp", "fsdp"), None, None)
+    bias = _rel_bias(params["enc_rel"], tokens.shape[1], tokens.shape[1],
+                     bidirectional=True, cfg=cfg)
+
+    def layer(x, lp):
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        x = _attn(h, x, lp, "w", cfg, bias, causal=False, mesh=mesh)
+        x = _ffn(x, lp, cfg, mesh)
+        return constrain(x, mesh, ("dp", "fsdp"), None, None), None
+
+    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = lax.scan(layer_fn, x, params["encoder"])
+    return _rmsnorm(x, params["enc_final_norm"], cfg.norm_eps)
+
+
+def t5_decode_train(params: dict, enc_out: jax.Array,
+                    dec_tokens: jax.Array, cfg: T5Config,
+                    mesh: Mesh | None = None) -> jax.Array:
+    """Teacher-forced decoder: [B, T] targets-in → logits [B, T, V]."""
+    x = jnp.take(params["embed"], dec_tokens, axis=0)
+    x = constrain(x, mesh, ("dp", "fsdp"), None, None)
+    t = dec_tokens.shape[1]
+    self_bias = _rel_bias(params["dec_rel"], t, t, bidirectional=False,
+                          cfg=cfg)
+
+    def layer(x, lp):
+        h = _rmsnorm(x, lp["self_norm"], cfg.norm_eps)
+        x = _attn(h, x, lp, "s", cfg, self_bias, causal=True, mesh=mesh)
+        h = _rmsnorm(x, lp["cross_norm"], cfg.norm_eps)
+        x = _attn(h, x, lp, "c", cfg, None, causal=False, mesh=mesh,
+                  kv_src=enc_out)
+        x = _ffn(x, lp, cfg, mesh)
+        return constrain(x, mesh, ("dp", "fsdp"), None, None), None
+
+    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = lax.scan(layer_fn, x, params["decoder"])
+    x = _rmsnorm(x, params["dec_final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return constrain(logits, mesh, ("dp", "fsdp"), None, "tp")
+
+
+def t5_forward(params: dict, enc_tokens: jax.Array,
+               dec_tokens: jax.Array, cfg: T5Config,
+               mesh: Mesh | None = None) -> jax.Array:
+    return t5_decode_train(params, t5_encode(params, enc_tokens, cfg,
+                                             mesh),
+                           dec_tokens, cfg, mesh)
+
+
+def seq2seq_loss(params: dict, enc_tokens: jax.Array,
+                 dec_tokens: jax.Array, cfg: T5Config,
+                 mesh: Mesh | None = None) -> jax.Array:
+    """Teacher-forced next-token loss on the decoder side: predict
+    dec_tokens[:, 1:] from dec_tokens[:, :-1] given the encoded input."""
+    logits = t5_forward(params, enc_tokens, dec_tokens[:, :-1], cfg,
+                        mesh)
+    targets = dec_tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def make_t5_train_step(cfg: T5Config, optimizer,
+                       mesh: Mesh | None = None):
+    """(params, opt_state, enc_tokens, dec_tokens) →
+    (params, opt_state, loss); callers jit with their shardings."""
+    import optax
+
+    def step(params, opt_state, enc_tokens, dec_tokens):
+        loss, grads = jax.value_and_grad(seq2seq_loss)(
+            params, enc_tokens, dec_tokens, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+    return step

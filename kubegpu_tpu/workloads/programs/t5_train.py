@@ -1,0 +1,68 @@
+"""T5 seq2seq training workload — the encoder-decoder family, single-
+or multi-worker via the injected TPU env (dp × tp mesh when the
+allocation's mesh axes say so).
+
+Env knobs:
+  T5_STEPS   train steps (default 4)
+  T5_TP      tensor-parallel width (default 1)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    from kubegpu_tpu.workloads.programs.distributed import init_from_env
+
+    env = init_from_env()
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubegpu_tpu.models.t5 import (
+        T5Config, make_t5_train_step, t5_init, t5_param_specs,
+    )
+    from kubegpu_tpu.parallel import make_mesh, named_sharding_tree
+    from kubegpu_tpu.parallel.sharding import fit_spec
+
+    steps = max(1, int(os.environ.get("T5_STEPS", "4")))
+    tp = max(1, int(os.environ.get("T5_TP", "1")))
+    cfg = T5Config.tiny()
+    n = jax.device_count()
+    mesh = make_mesh({"dp": n // tp, "tp": tp})
+
+    params = jax.device_put(
+        t5_init(jax.random.PRNGKey(0), cfg),
+        named_sharding_tree(mesh, t5_param_specs(cfg)))
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_t5_train_step(cfg, opt, mesh),
+                   donate_argnums=(0, 1))
+    dp = n // tp
+    batch = dp * max(1, 8 // dp)   # always divisible by the dp axis
+    sh = NamedSharding(mesh, fit_spec(mesh, P("dp", None)))
+    # one FIXED batch so the loss-decrease gate measures the same data
+    enc = jax.device_put(jax.random.randint(
+        jax.random.PRNGKey(1), (batch, 16), 0, cfg.vocab_size), sh)
+    dec = jax.device_put(jax.random.randint(
+        jax.random.PRNGKey(2), (batch, 12), 0, cfg.vocab_size), sh)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, enc, dec)
+        losses.append(float(loss))
+
+    if env.worker_id == 0:
+        print(f"t5: devices={n} tp={tp} "
+              f"losses={[round(l, 4) for l in losses]}")
+    if not all(np.isfinite(losses)) or (
+            len(losses) > 1 and not losses[-1] < losses[0]):
+        print("FAIL: loss not improving", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

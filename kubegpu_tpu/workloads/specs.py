@@ -97,6 +97,14 @@ def allreduce_gang(n_pods: int = 4,
     return pods, [slice_type]
 
 
+def t5_seq2seq(slice_type: str = "v4-8") -> tuple[list[Pod], list[str]]:
+    """Encoder-decoder family on one chip (the seq2seq counterpart of
+    config2's single-chip training)."""
+    pods = [tpu_pod("t5", chips=1, command=_prog("t5_train"),
+                    env={"T5_STEPS": "3"})]
+    return pods, [slice_type]
+
+
 ALL_CONFIGS = {
     "config1": config1_cpu_mnist,
     "config2": config2_resnet_1chip,
@@ -104,4 +112,5 @@ ALL_CONFIGS = {
     "config4": config4_llama_v5e16,
     "config5": config5_multitenant,
     "allreduce": allreduce_gang,
+    "t5": t5_seq2seq,
 }

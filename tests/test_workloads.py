@@ -111,3 +111,23 @@ class TestRealDistributedExecution:
         out2 = run("train-b")  # "rescheduled gang" resumes
         # params AND optimizer moments restored (review regression)
         assert "start_step=2" in out2 and "resumed_opt=True" in out2
+
+
+@pytest.mark.slow
+class TestT5Workload:
+    def test_t5_single_chip_real_process(self):
+        """The encoder-decoder family runs as a REAL subprocess through
+        schedule → injection → training with decreasing loss."""
+        pods, slice_types = specs.t5_seq2seq()
+        cl = SimCluster(slice_types, real_processes=True,
+                        extra_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            cl.submit(*pods)
+            codes = cl.run_to_completion(timeout_s=300)
+            assert codes == {"t5": 0}, (
+                codes, cl.api.get("Pod", "t5").status.message)
+            out = next(h for h in cl.runtime.containers()
+                       if h.pod_name == "t5").stdout
+            assert "losses=" in out
+        finally:
+            cl.close()
