@@ -1,0 +1,177 @@
+"""Input pipeline for the workload layer — TPU-native data loading.
+
+The reference scheduled jobs and left data loading to the workload
+(SURVEY.md §3: no first-party loader); KubeTPU's workloads need the
+three standard TPU input-pipeline pieces, built jit/multi-host-clean:
+
+1. :class:`ShardedBatcher` — deterministic, seeded epoch iteration
+   where each worker of the gang reads a DISJOINT shard: one global
+   permutation per epoch (same on every worker, derived from
+   (seed, epoch) only), sliced per worker.  Workers never exchange
+   indices and still partition every epoch exactly.
+2. :func:`prefetch_to_device` — double-buffered host→device transfer:
+   batch N+1's H2D overlaps batch N's compute (the usual hiding of
+   PCIe/DMA latency behind the step).
+3. :func:`global_batches` — wraps each process's LOCAL batch into a
+   global jax.Array laid out by a mesh sharding
+   (``jax.make_array_from_process_local_data``), so a dp-sharded
+   global batch assembles without any cross-host gather.
+
+Everything is numpy/jax only — real datasets plug in as array sources;
+the synthetic sources used by the example workloads live here too.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass
+from typing import Iterable, Iterator
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Shard:
+    """This worker's slice of the gang: ``index`` of ``count``."""
+    index: int = 0
+    count: int = 1
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.index < self.count:
+            raise ValueError(f"shard {self.index} not in [0,{self.count})")
+
+    @classmethod
+    def from_worker_env(cls, env=None) -> "Shard":
+        """From the injected gang env, parsed by the ONE owner of that
+        contract (``workloads.programs.distributed.read_env`` — the
+        crishim's wiring, SURVEY.md §4.3).  Pass an existing
+        ``WorkerEnv`` to avoid re-reading os.environ."""
+        if env is None:
+            from kubegpu_tpu.workloads.programs.distributed import read_env
+            env = read_env()
+        return cls(index=env.worker_id, count=env.num_workers)
+
+
+class ShardedBatcher:
+    """Deterministic sharded epoch iteration over array-shaped data.
+
+    ``arrays`` is a dict of equal-leading-dim numpy arrays (features,
+    labels, ...).  Per epoch: one global permutation seeded by
+    ``(seed, epoch)`` — identical on every worker — is cut into
+    per-worker contiguous slices; each worker batches its slice.
+    ``drop_remainder`` keeps batch shapes static for jit (the tail
+    examples of an epoch are dropped, different ones each epoch thanks
+    to the reshuffle)."""
+
+    def __init__(self, arrays: dict[str, np.ndarray], batch_size: int,
+                 shard: Shard | None = None, seed: int = 0,
+                 shuffle: bool = True, drop_remainder: bool = True):
+        if not arrays:
+            raise ValueError("arrays must be non-empty")
+        sizes = {k: len(v) for k, v in arrays.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"leading dims differ: {sizes}")
+        self.arrays = dict(arrays)
+        self.n = next(iter(sizes.values()))
+        self.batch_size = batch_size
+        self.shard = shard or Shard()
+        if self.n < self.shard.count:
+            raise ValueError(
+                f"{self.n} examples cannot shard {self.shard.count} ways")
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+
+    def epoch_indices(self, epoch: int) -> np.ndarray:
+        """This worker's example indices for ``epoch`` (disjoint across
+        workers; the union over workers is all n, minus the per-epoch
+        tail that doesn't split evenly across the gang)."""
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, epoch))
+            perm = rng.permutation(self.n)
+        else:
+            perm = np.arange(self.n)
+        per = self.n // self.shard.count
+        lo = self.shard.index * per
+        return perm[lo:lo + per]
+
+    def batches(self, epoch: int = 0) -> Iterator[dict[str, np.ndarray]]:
+        idx = self.epoch_indices(epoch)
+        n_full = len(idx) // self.batch_size
+        end = n_full * self.batch_size if self.drop_remainder else len(idx)
+        for lo in range(0, end, self.batch_size):
+            sel = idx[lo:lo + self.batch_size]
+            yield {k: v[sel] for k, v in self.arrays.items()}
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        """Endless stream: epoch 0, 1, 2, ... reshuffled each time."""
+        epoch = 0
+        while True:
+            yield from self.batches(epoch)
+            epoch += 1
+
+
+def prefetch_to_device(it: Iterable, size: int = 2,
+                       sharding=None) -> Iterator:
+    """Keep ``size`` batches in flight on the device: each element is
+    ``jax.device_put`` (with ``sharding`` when given) as soon as a slot
+    frees, so the transfer of batch N+1 overlaps the compute consuming
+    batch N.  jax transfers are async — device_put returns immediately
+    and the queue depth is the buffer."""
+    import jax
+
+    def put(x):
+        return jax.device_put(x, sharding) if sharding is not None \
+            else jax.device_put(x)
+
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    queue: collections.deque = collections.deque()
+    it = iter(it)
+    try:
+        for _ in range(size):
+            queue.append(jax.tree.map(put, next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(jax.tree.map(put, next(it)))
+        except StopIteration:
+            pass
+        yield out
+
+
+def global_batches(it: Iterable, mesh, spec) -> Iterator:
+    """Assemble each process-local batch into a GLOBAL jax.Array laid
+    out by ``NamedSharding(mesh, spec)`` — multi-host dp: every process
+    feeds only its own shard's rows and the global batch exists without
+    any cross-host data movement (addressable shards only)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    for batch in it:
+        yield jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)),
+            batch)
+
+
+def synthetic_tokens(n: int, seq_len: int, vocab_size: int,
+                     seed: int = 0) -> dict[str, np.ndarray]:
+    """Deterministic synthetic causal-LM dataset ({'tokens': [n, T+1]})."""
+    rng = np.random.default_rng(seed)
+    return {"tokens": rng.integers(
+        0, vocab_size, (n, seq_len + 1), dtype=np.int32)}
+
+
+def synthetic_images(n: int, size: int, n_classes: int,
+                     seed: int = 0) -> dict[str, np.ndarray]:
+    """Deterministic synthetic image-classification dataset."""
+    rng = np.random.default_rng(seed)
+    return {
+        "images": rng.standard_normal((n, size, size, 3),
+                                      dtype=np.float32),
+        "labels": rng.integers(0, n_classes, (n,), dtype=np.int32),
+    }

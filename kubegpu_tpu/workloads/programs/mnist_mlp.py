@@ -27,12 +27,23 @@ def main() -> int:
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
     import optax
 
+    from kubegpu_tpu.workloads.data import (
+        Shard, ShardedBatcher, prefetch_to_device,
+    )
+    from kubegpu_tpu.workloads.programs.distributed import read_env
+
     key = jax.random.PRNGKey(0)
-    k1, k2, k3 = jax.random.split(key, 3)
-    x = jax.random.normal(k1, (256, 784))
-    y = jax.random.randint(k2, (256,), 0, 10)
+    k3 = jax.random.split(key, 3)[2]
+    # the input pipeline: this worker's disjoint shard of a fixed
+    # synthetic dataset, batched + double-buffered onto the device
+    rng = np.random.default_rng(0)
+    data = {"x": rng.standard_normal((256, 784), dtype=np.float32),
+            "y": rng.integers(0, 10, (256,), dtype=np.int32)}
+    batcher = ShardedBatcher(data, batch_size=64,
+                             shard=Shard.from_worker_env(read_env()))
 
     def init(k):
         k_a, k_b = jax.random.split(k)
@@ -59,12 +70,18 @@ def main() -> int:
         updates, opt_state = opt.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    first = None
-    for i in range(20):
-        params, opt_state, loss = step(params, opt_state, x, y)
-        if first is None:
-            first = float(loss)
-    last = float(loss)
+    first = last = None
+    for epoch in range(10):
+        # fresh reshuffled epoch each pass; loss is averaged per epoch
+        # so the decrease gate compares like against like
+        epoch_losses = []
+        for batch in prefetch_to_device(batcher.batches(epoch), size=2):
+            params, opt_state, loss = step(params, opt_state,
+                                           batch["x"], batch["y"])
+            epoch_losses.append(float(loss))
+        mean = sum(epoch_losses) / len(epoch_losses)
+        first = first if first is not None else mean
+        last = mean
     print(f"mnist_mlp: first_loss={first:.4f} last_loss={last:.4f} "
           f"devices={jax.device_count()} worker_id="
           f"{os.environ.get('TPU_WORKER_ID', 'unset')}")
