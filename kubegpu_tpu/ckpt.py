@@ -1,0 +1,98 @@
+"""Checkpoint/resume utility — the workload-side half of the elastic
+story (SURVEY.md §6: annotations are the SCHEDULER's durable state; a
+rescheduled gang's training state is the workload's, via orbax).
+
+:class:`TrainCheckpointer` wraps ``orbax.checkpoint.CheckpointManager``
+with the three things every KubeTPU workload needs and llama_pjit
+previously hand-rolled:
+
+- **restore-or-init**: resume from the latest step if one exists —
+  params AND optimizer state (resetting adamw moments on reschedule is
+  a silent training regression) — else start at step 0;
+- **sharding-aware restore**: restored arrays are ``device_put`` onto
+  the caller's NamedSharding tree, so a gang that comes back on a
+  different slice (the fault-recovery path) re-lays out its state for
+  the new mesh;
+- **retention + cadence**: ``save_interval_steps`` gates how often
+  ``maybe_save`` actually writes; orbax's ``max_to_keep`` bounds disk.
+
+Checkpoint layout is orbax-standard, so checkpoints written by one
+workload restore anywhere orbax runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class TrainCheckpointer:
+    def __init__(self, directory: str, max_to_keep: int | None = None,
+                 save_interval_steps: int = 1):
+        """``max_to_keep=None`` retains every checkpoint (orbax's own
+        default, and what the workloads did before this utility —
+        silent deletion of resume history is an opt-IN)."""
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = directory
+        self.save_interval_steps = max(1, save_interval_steps)
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True))
+
+    @property
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def restore_or_init(self, state: dict, shardings: dict | None = None
+                        ) -> tuple[dict, int]:
+        """(state, next_step): the latest checkpoint restored, or the
+        given initial ``state`` at step 0.
+
+        ``state`` is a top-level dict (the ``{"params": ...,
+        "opt_state": ...}`` convention); ``shardings`` maps a SUBSET of
+        its keys to NamedSharding trees — those entries are
+        ``device_put`` onto their mesh layout after restore (the gang
+        may have come back on a different slice), the rest keep orbax's
+        placement."""
+        import jax
+
+        if shardings:
+            # validate BEFORE touching disk: a bad key must not surface
+            # as an orbax structure error on an unrelated template
+            unknown = set(shardings) - set(state)
+            if unknown:
+                raise KeyError(f"shardings for unknown state keys "
+                               f"{sorted(unknown)}")
+        latest = self.manager.latest_step()
+        if latest is None:
+            return state, 0
+        restored = self.manager.restore(
+            latest, args=self._ocp.args.StandardRestore(state))
+        if shardings:
+            restored = {**restored,
+                        **{k: jax.device_put(restored[k], s)
+                           for k, s in shardings.items()}}
+        return restored, latest + 1
+
+    def maybe_save(self, step: int, state: Any) -> bool:
+        """Save iff ``step`` is on the cadence; returns whether it did."""
+        if (step + 1) % self.save_interval_steps:
+            return False
+        self.save(step, state)
+        return True
+
+    def save(self, step: int, state: Any) -> None:
+        self.manager.save(step,
+                          args=self._ocp.args.StandardSave(state))
+
+    def wait(self) -> None:
+        """Block until async saves are durable (call before exiting —
+        a gang member killed mid-save must not leave a torn step as
+        'latest')."""
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self.manager.close()

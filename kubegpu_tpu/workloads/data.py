@@ -73,11 +73,22 @@ class ShardedBatcher:
             raise ValueError(f"leading dims differ: {sizes}")
         self.arrays = dict(arrays)
         self.n = next(iter(sizes.values()))
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self.batch_size = batch_size
         self.shard = shard or Shard()
         if self.n < self.shard.count:
             raise ValueError(
                 f"{self.n} examples cannot shard {self.shard.count} ways")
+        per = self.n // self.shard.count
+        if drop_remainder and per < batch_size:
+            # would silently yield ZERO batches every epoch — fail loud
+            # at construction with the numbers the operator needs
+            raise ValueError(
+                f"per-worker shard of {per} examples (n={self.n} / "
+                f"{self.shard.count} workers) cannot fill one batch of "
+                f"{batch_size} with drop_remainder; shrink the batch or "
+                f"the gang")
         self.seed = seed
         self.shuffle = shuffle
         self.drop_remainder = drop_remainder
@@ -175,3 +186,12 @@ def synthetic_images(n: int, size: int, n_classes: int,
                                       dtype=np.float32),
         "labels": rng.integers(0, n_classes, (n,), dtype=np.int32),
     }
+
+
+def synthetic_features(n: int, dim: int, n_classes: int,
+                       seed: int = 0) -> dict[str, np.ndarray]:
+    """Deterministic flat-feature classification dataset
+    ({'x': [n, dim] f32, 'y': [n] i32}) — the MLP workloads' source."""
+    rng = np.random.default_rng(seed)
+    return {"x": rng.standard_normal((n, dim), dtype=np.float32),
+            "y": rng.integers(0, n_classes, (n,), dtype=np.int32)}

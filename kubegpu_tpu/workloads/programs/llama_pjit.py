@@ -95,20 +95,15 @@ def main() -> int:
     ckpt_dir = os.environ.get("LLAMA_CKPT_DIR")
     start_step = 0
     resumed_opt = False
+    ckpt = None
     if ckpt_dir:
-        import orbax.checkpoint as ocp
-        mngr = ocp.CheckpointManager(ckpt_dir)
-        latest = mngr.latest_step()
-        if latest is not None:
-            # restore params AND optimizer state — resetting adamw
-            # moments on reschedule is a silent training regression
-            state = {"params": params, "opt_state": opt_state}
-            restored = mngr.restore(
-                latest, args=ocp.args.StandardRestore(state))
-            params = jax.device_put(restored["params"], specs)
-            opt_state = restored["opt_state"]
-            resumed_opt = True
-            start_step = latest + 1
+        from kubegpu_tpu.ckpt import TrainCheckpointer
+        ckpt = TrainCheckpointer(ckpt_dir)
+        state, start_step = ckpt.restore_or_init(
+            {"params": params, "opt_state": opt_state},
+            shardings={"params": specs})
+        params, opt_state = state["params"], state["opt_state"]
+        resumed_opt = start_step > 0
 
     step_fn = jax.jit(make_train_step(cfg, opt, mesh),
                       donate_argnums=(0, 1))
@@ -133,12 +128,10 @@ def main() -> int:
         if profiling:
             jax.profiler.stop_trace()
 
-    if ckpt_dir:
-        import orbax.checkpoint as ocp
-        last = start_step + steps - 1
-        mngr.save(last, args=ocp.args.StandardSave(
-            {"params": params, "opt_state": opt_state}))
-        mngr.wait_until_finished()
+    if ckpt is not None:
+        ckpt.save(start_step + steps - 1,
+                  {"params": params, "opt_state": opt_state})
+        ckpt.wait()
 
     if env.worker_id == 0:
         print(f"llama_pjit: preset={preset} mesh={axes} "

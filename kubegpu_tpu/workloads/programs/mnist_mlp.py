@@ -27,11 +27,10 @@ def main() -> int:
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
     from kubegpu_tpu.workloads.data import (
-        Shard, ShardedBatcher, prefetch_to_device,
+        Shard, ShardedBatcher, prefetch_to_device, synthetic_features,
     )
     from kubegpu_tpu.workloads.programs.distributed import read_env
 
@@ -39,10 +38,8 @@ def main() -> int:
     k3 = jax.random.split(key, 3)[2]
     # the input pipeline: this worker's disjoint shard of a fixed
     # synthetic dataset, batched + double-buffered onto the device
-    rng = np.random.default_rng(0)
-    data = {"x": rng.standard_normal((256, 784), dtype=np.float32),
-            "y": rng.integers(0, 10, (256,), dtype=np.int32)}
-    batcher = ShardedBatcher(data, batch_size=64,
+    batcher = ShardedBatcher(synthetic_features(256, 784, 10),
+                             batch_size=64,
                              shard=Shard.from_worker_env(read_env()))
 
     def init(k):
