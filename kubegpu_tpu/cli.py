@@ -186,7 +186,10 @@ def _build_cluster(args, slices: list[str]) -> SimCluster:
     return SimCluster.from_config(cfg)
 
 
-def cmd_apply(args) -> int:
+def _run_spec(args):
+    """Shared spec pipeline for apply/top/metrics: load, build, quota,
+    submit, schedule (or run).  Returns the live SimCluster, or an int
+    exit code on spec errors — caller must close() the cluster."""
     spec = load_spec_file(args.file)
     pods, slices = pods_from_spec(spec)
     if not pods:
@@ -200,6 +203,13 @@ def cmd_apply(args) -> int:
         cl.step()
     else:
         cl.run_to_completion(timeout_s=args.timeout)
+    return cl
+
+
+def cmd_apply(args) -> int:
+    cl = _run_spec(args)
+    if isinstance(cl, int):
+        return cl
     render_pod_table(cl)
     if args.top:
         print()
@@ -250,6 +260,20 @@ def cmd_bench(args) -> int:
     else:   # scheduler half only — fast, no accelerator involvement
         out = run_bench(n_gangs=args.gangs, seed=args.seed)
     print(json.dumps(out))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Run a spec and dump the cluster metrics registry — the same
+    content GET /metrics serves on the extender webhook, from the CLI."""
+    cl = _run_spec(args)
+    if isinstance(cl, int):
+        return cl
+    if args.format == "prometheus":
+        print(cl.metrics.to_prometheus(), end="")
+    else:
+        print(json.dumps(cl.metrics.snapshot(), indent=2, sort_keys=True))
+    cl.close()
     return 0
 
 
@@ -326,6 +350,15 @@ def main(argv: list[str] | None = None) -> int:
                    "tokens/s, pallas-vs-XLA attention) on the default "
                    "accelerator; results land under details.model")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser("metrics",
+                       help="run a spec and dump the metrics registry")
+    common(p, with_file=True)
+    p.add_argument("--schedule-only", action="store_true",
+                   help="schedule but do not execute workloads")
+    p.add_argument("--format", choices=["json", "prometheus"],
+                   default="json")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("slices", help="list known TPU slice types")
     p.set_defaults(fn=cmd_slices)

@@ -194,3 +194,20 @@ class TestExampleSpecs:
         by_name = {p.name: p for p in pods}
         assert by_name["urgent"].spec.priority == 10
         assert by_name["batch-0"].spec.priority == 0
+
+    def test_metrics_verb(self, tmp_path, capsys):
+        spec = tmp_path / "spec.json"
+        spec.write_text(json.dumps({
+            "cluster": {"slices": ["v4-8"]},
+            "pods": [{"name": "p", "chips": 1, "command": ["noop"]}],
+        }))
+        rc = main(["metrics", "-f", str(spec), "--schedule-only"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        snap = json.loads(out)
+        assert snap["histograms"]["schedule_latency_ms"]["count"] >= 1
+        rc = main(["metrics", "-f", str(spec), "--schedule-only",
+                   "--format", "prometheus"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "# TYPE kubetpu_schedule_latency_ms summary" in out
