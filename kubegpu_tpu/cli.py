@@ -273,8 +273,10 @@ def cmd_metrics(args) -> int:
         print(cl.metrics.to_prometheus(), end="")
     else:
         print(json.dumps(cl.metrics.snapshot(), indent=2, sort_keys=True))
+    bad = [p for p in cl.api.list("Pod")
+           if p.status.phase == PodPhase.FAILED]   # match apply's gate
     cl.close()
-    return 0
+    return 1 if bad else 0
 
 
 def cmd_slices(args) -> int:

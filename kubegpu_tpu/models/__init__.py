@@ -22,6 +22,13 @@ from kubegpu_tpu.models.moe import (
     moe_init,
     moe_param_specs,
 )
+from kubegpu_tpu.models.lora import (
+    LoRAConfig,
+    lora_init,
+    lora_merge,
+    lora_param_specs,
+    make_lora_train_step,
+)
 from kubegpu_tpu.models.quant import QTensor, quantize_llama
 from kubegpu_tpu.models.t5 import (
     T5Config,
@@ -44,4 +51,6 @@ __all__ = [
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
     "sample_generate",
     "QTensor", "quantize_llama",
+    "LoRAConfig", "lora_init", "lora_merge", "lora_param_specs",
+    "make_lora_train_step",
 ]

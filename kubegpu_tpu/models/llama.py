@@ -244,18 +244,52 @@ def next_token_loss(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
 
 def make_train_step(cfg, optimizer, mesh: Mesh | None = None,
-                    loss_fn=None):
+                    loss_fn=None, accum_steps: int = 1):
     """(params, opt_state, tokens) → (params, opt_state, loss), undecorated
     (callers jit with their shardings).  ``loss_fn(params, tokens, cfg,
     mesh)`` defaults to the Llama next-token loss; the MoE step reuses
-    this with its own loss."""
+    this with its own loss.
+
+    ``accum_steps > 1`` splits the batch into that many equal
+    microbatches and accumulates their grads under ``lax.scan`` before
+    ONE optimizer update — activation memory scales with the microbatch
+    while the effective batch (and, for equal-size microbatches, the
+    resulting update) stays that of the full batch.  Trades steps for
+    HBM: the lever when a model fits but its activations don't."""
     import optax
 
     loss_fn = loss_fn if loss_fn is not None else next_token_loss
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, tokens, cfg, mesh)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, cfg, mesh)
+        else:
+            b = tokens.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps "
+                    f"{accum_steps}")
+            micro = tokens.reshape(accum_steps, b // accum_steps,
+                                   *tokens.shape[1:])
+
+            def acc(carry, mb):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, mb, cfg, mesh)
+                return (loss_sum + loss,
+                        jax.tree.map(jnp.add, grad_sum, grads)), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                acc, (jnp.float32(0.0), zeros), micro)
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g / accum_steps).astype(p.dtype),
+                grad_sum, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

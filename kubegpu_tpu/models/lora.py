@@ -1,0 +1,134 @@
+"""LoRA adapters for the Llama family (TPU-native addition).
+
+Parameter-efficient fine-tuning: frozen base weights + trainable
+low-rank deltas ``w_eff = w + (alpha/rank) * a @ b`` on selected matmul
+weights.  Fits the house design the same way int8 serving does — the
+model code only uses weights via ``@``, so training traces
+:func:`lora_merge` (the a@b delta is tiny: [in,r]@[r,out], XLA fuses
+it) and the existing forward/loss run UNCHANGED on the merged tree,
+while :func:`make_lora_train_step` differentiates and updates ONLY the
+adapters.  On a gang, adapters shard like their base weights'
+non-contracted dims (a on fsdp, b on tp), so tp/fsdp training works
+with no new collectives.
+
+Memory story (why LoRA on TPU): optimizer moments exist only for the
+adapters — for the 618M-param bench config at rank 8 on wq/wv that is
+~0.3% of the adamw state, the difference between fitting and OOM when
+fine-tuning bigger-than-bench models in 16 GiB.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# the classic attention-only default (LoRA paper: q and v projections)
+DEFAULT_TARGETS = ("wq", "wv")
+# every stacked matmul weight that CAN take an adapter
+ADAPTABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple[str, ...] = DEFAULT_TARGETS
+
+    def __post_init__(self) -> None:
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        bad = set(self.targets) - set(ADAPTABLE)
+        if bad:
+            raise ValueError(f"unknown LoRA targets {sorted(bad)}; "
+                             f"adaptable: {ADAPTABLE}")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def lora_init(key: jax.Array, params: dict, lcfg: LoRAConfig) -> dict:
+    """Adapters for the targeted stacked-layer weights: per target,
+    ``a`` [L, in, r] (gaussian / sqrt(in)) and ``b`` [L, r, out]
+    (zeros) — so the initial delta is exactly zero and step 0 of
+    fine-tuning IS the base model."""
+    out = {}
+    keys = jax.random.split(key, len(lcfg.targets))
+    for k, name in zip(keys, lcfg.targets):
+        w = params["layers"][name]           # [L, in, out]
+        ell, d_in, d_out = w.shape
+        out[name] = {
+            "a": (jax.random.normal(k, (ell, d_in, lcfg.rank),
+                                    jnp.float32)
+                  * (d_in ** -0.5)).astype(w.dtype),
+            "b": jnp.zeros((ell, lcfg.rank, d_out), w.dtype),
+        }
+    return out
+
+
+# each adaptable weight's (input-dim, output-dim) mesh axes, mirroring
+# llama_param_specs: the down/out projections are transposed (megatron
+# row-parallel), so their adapters must shard the SAME axes as the base
+# or XLA inserts per-step resharding collectives around the merge
+_IN_OUT_AXES = {
+    "wq": ("fsdp", "tp"), "wk": ("fsdp", "tp"), "wv": ("fsdp", "tp"),
+    "w_gate": ("fsdp", "tp"), "w_up": ("fsdp", "tp"),
+    "wo": ("tp", "fsdp"), "w_down": ("tp", "fsdp"),
+}
+
+
+def lora_param_specs(lcfg: LoRAConfig) -> dict:
+    """GSPMD specs: ``a`` shards its input dim and ``b`` its output dim
+    on the SAME axes the base weight uses for those dims (transposed
+    for the row-parallel wo/w_down) — the rank dim replicates."""
+    out = {}
+    for name in lcfg.targets:
+        ax_in, ax_out = _IN_OUT_AXES[name]
+        out[name] = {"a": P(None, ax_in, None),
+                     "b": P(None, None, ax_out)}
+    return out
+
+
+def lora_merge(params: dict, adapters: dict, lcfg: LoRAConfig) -> dict:
+    """Base tree with targeted weights replaced by w + scale * a@b —
+    trace this inside the loss (cheap) or call once to bake adapters in
+    for serving (the merged tree drops into decode/quantize unchanged)."""
+    layers = dict(params["layers"])
+    for name, ab in adapters.items():
+        delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"])
+        layers[name] = params["layers"][name] \
+            + (lcfg.scaling * delta).astype(params["layers"][name].dtype)
+    return {**params, "layers": layers}
+
+
+def lora_n_params(adapters: dict) -> int:
+    return sum(x.size for x in jax.tree.leaves(adapters))
+
+
+def make_lora_train_step(cfg, lcfg: LoRAConfig, optimizer,
+                         mesh=None, loss_fn=None):
+    """(adapters, opt_state, base_params, tokens) →
+    (adapters, opt_state, loss): grads flow ONLY to the adapters; base
+    params pass through untouched (freeze by construction, not by
+    masking).  ``loss_fn`` defaults to the Llama next-token loss."""
+    import optax
+
+    from kubegpu_tpu.models.llama import next_token_loss
+
+    loss_fn = loss_fn if loss_fn is not None else next_token_loss
+
+    def adapter_loss(adapters, base_params, tokens):
+        merged = lora_merge(base_params, adapters, lcfg)
+        return loss_fn(merged, tokens, cfg, mesh)
+
+    def step(adapters, opt_state, base_params, tokens):
+        loss, grads = jax.value_and_grad(adapter_loss)(
+            adapters, base_params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, adapters)
+        adapters = optax.apply_updates(adapters, updates)
+        return adapters, opt_state, loss
+
+    return step

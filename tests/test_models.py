@@ -129,3 +129,42 @@ class TestShardedExecution:
         out = jax.jit(
             lambda p, t: next_token_loss(p, t, cfg, mesh))(sharded, tokens)
         assert abs(float(out) - float(ref)) < 1e-3
+
+
+class TestGradAccumulation:
+    def test_accumulated_matches_full_batch(self):
+        """accum_steps=4 over a batch of 8 must produce the same update
+        as one full-batch step (equal microbatches => identical mean
+        grads, modulo f32 accumulation order)."""
+        import optax
+
+        from kubegpu_tpu.models.llama import make_train_step
+
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        opt = optax.sgd(1e-2)   # stateless-ish: isolates the grads
+        tokens = (jnp.arange(8 * 17, dtype=jnp.int32).reshape(8, 17) * 3
+                  ) % cfg.vocab_size
+        full = jax.jit(make_train_step(cfg, opt))
+        accu = jax.jit(make_train_step(cfg, opt, accum_steps=4))
+        p1, _, l1 = full(params, opt.init(params), tokens)
+        p2, _, l2 = accu(params, opt.init(params), tokens)
+        assert float(l1) == pytest.approx(float(l2), rel=1e-5)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_validation(self):
+        import optax
+
+        from kubegpu_tpu.models.llama import make_train_step
+
+        cfg = LlamaConfig.tiny()
+        with pytest.raises(ValueError, match="accum_steps"):
+            make_train_step(cfg, optax.sgd(1e-2), accum_steps=0)
+        step = jax.jit(make_train_step(cfg, optax.sgd(1e-2),
+                                       accum_steps=3))
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((8, 17), jnp.int32)   # 8 % 3 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            step(params, optax.sgd(1e-2).init(params), tokens)
