@@ -4,6 +4,7 @@ with a KV-cache serving path, Mixtral-style MoE, ResNet-50 (flax), and
 the MNIST MLP (inside workloads/programs)."""
 
 from kubegpu_tpu.models.decode import (
+    beam_generate,
     decode_step,
     greedy_generate,
     init_kv_cache,
@@ -49,7 +50,7 @@ __all__ = [
     "T5Config", "t5_forward", "t5_init", "t5_param_specs",
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
-    "sample_generate",
+    "sample_generate", "beam_generate",
     "QTensor", "quantize_llama",
     "LoRAConfig", "lora_init", "lora_merge", "lora_param_specs",
     "make_lora_train_step",

@@ -350,6 +350,83 @@ def sample_generate(params: dict, prompt: jax.Array, n_steps: int,
         jnp.float32(temperature), jnp.float32(top_p))
 
 
+@functools.lru_cache(maxsize=64)
+def _beam_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
+             beams: int, kv_int8: bool):
+    """Compiled beam-search executable.  Beams ride the batch dim
+    (cache shape [L, B*W, ...]); each step scores (beam × vocab) jointly,
+    keeps the top W continuations per sequence, and gathers the cache
+    rows of the surviving beams (static shapes throughout: the gather
+    is a take along the flattened batch*beam axis)."""
+
+    @jax.jit
+    def run(params, prompt):
+        b = prompt.shape[0]
+        # prefill ONCE on [B, T] — the W beams of a sequence share a
+        # byte-identical prompt, so the prompt forward (FLOPs-dominant
+        # for long prompts) must not run W times; the primed cache rows
+        # repeat along the batch axis instead
+        logits, cache = prefill(params, prompt, cfg, max_len,
+                                kv_int8=kv_int8)
+        cache = jax.tree.map(lambda c: jnp.repeat(c, beams, axis=1),
+                             cache)
+        first_lp = jax.nn.log_softmax(logits, axis=-1)  # [B, V]
+        v = first_lp.shape[-1]
+        # initial frontier: the top W distinct first tokens
+        scores, first_tok = lax.top_k(first_lp, beams)  # [B, W]
+        tokens0 = first_tok.reshape(b * beams).astype(prompt.dtype)
+
+        def step(carry, i):
+            scores, token, cache, out = carry
+            # iteration i consumes the token at global position t+i
+            # (tokens0 sits at t), same bookkeeping as _rollout
+            logits, cache = decode_step(params, cache, token, t + i, cfg)
+            logp = jax.nn.log_softmax(logits, axis=-1)  # [B*W, V]
+            joint = scores.reshape(b, beams, 1) \
+                + logp.reshape(b, beams, v)             # [B, W, V]
+            flat = joint.reshape(b, beams * v)
+            scores, idx = lax.top_k(flat, beams)        # [B, W]
+            src_beam = idx // v                         # [B, W] in [0,W)
+            token = (idx % v).reshape(b * beams).astype(token.dtype)
+            # gather surviving beams' cache rows + running outputs
+            rows = (jnp.arange(b)[:, None] * beams
+                    + src_beam).reshape(b * beams)      # flat batch idx
+            cache = jax.tree.map(lambda c: jnp.take(c, rows, axis=1),
+                                 cache)
+            out = jnp.take(out, rows, axis=0)
+            out = out.at[:, i + 1].set(token)
+            return (scores, token, cache, out), None
+
+        out0 = jnp.zeros((b * beams, n_steps), prompt.dtype)
+        out0 = out0.at[:, 0].set(tokens0)
+        (scores, _, _, out), _ = lax.scan(
+            step, (scores, tokens0, cache, out0),
+            jnp.arange(n_steps - 1))
+        # best beam per sequence (beams are score-sorted by top_k)
+        best = out.reshape(b, beams, n_steps)[:, 0]
+        return best, scores[:, 0]
+
+    return run
+
+
+def beam_generate(params: dict, prompt: jax.Array, n_steps: int,
+                  cfg: LlamaConfig, beams: int = 4,
+                  max_len: int | None = None,
+                  kv_int8: bool = False
+                  ) -> tuple[jax.Array, jax.Array]:
+    """Beam search over the KV-cache decode loop: returns (tokens
+    [B, n_steps] — the best beam per sequence — and its total
+    log-probability [B]).  Length-agnostic scoring (sum of logprobs;
+    all beams have equal length here, so no normalization is needed)."""
+    max_len = _validate_rollout(cfg, prompt.shape[1], n_steps, max_len)
+    if not 1 <= beams <= cfg.vocab_size:
+        raise ValueError(
+            f"beams must be in [1, vocab_size={cfg.vocab_size}], "
+            f"got {beams}")
+    return _beam_fn(cfg, prompt.shape[1], n_steps, max_len, beams,
+                    kv_int8)(params, prompt)
+
+
 def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
                     cfg: LlamaConfig,
                     max_len: int | None = None,
