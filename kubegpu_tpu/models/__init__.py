@@ -19,9 +19,12 @@ from kubegpu_tpu.models.llama import (
 )
 from kubegpu_tpu.models.moe import (
     MoEConfig,
+    moe_decode_step,
     moe_forward,
+    moe_greedy_generate,
     moe_init,
     moe_param_specs,
+    moe_prefill,
 )
 from kubegpu_tpu.models.lora import (
     LoRAConfig,
@@ -47,6 +50,7 @@ from kubegpu_tpu.models.vit import (
 __all__ = [
     "LlamaConfig", "llama_forward", "llama_init", "llama_param_specs",
     "MoEConfig", "moe_forward", "moe_init", "moe_param_specs",
+    "moe_prefill", "moe_decode_step", "moe_greedy_generate",
     "T5Config", "t5_forward", "t5_init", "t5_param_specs",
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",

@@ -116,15 +116,27 @@ def _cached_attend_q8(q: jax.Array, ck: jax.Array, cv: jax.Array,
     return out.reshape(b, hq, t, d).astype(q.dtype)
 
 
+def _dense_ffn(x: jax.Array, lp: dict, cfg: LlamaConfig) -> jax.Array:
+    """The Llama SwiGLU FFN sublayer (residual included) — the default
+    ``ffn`` of the cached forward; the MoE family swaps in its routed
+    experts here (models/moe.py serving section)."""
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+    return x + (up @ lp["w_down"]).astype(x.dtype)
+
+
 def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
-                        pos_offset: jax.Array, cfg: LlamaConfig
-                        ) -> tuple[jax.Array, dict]:
+                        pos_offset: jax.Array, cfg: LlamaConfig,
+                        ffn=None) -> tuple[jax.Array, dict]:
     """Run the decoder over ``tokens`` [B, T] starting at global position
     ``pos_offset`` (scalar), reading + writing the cache.  Returns
     (logits [B, T, vocab] f32, updated cache).  T=prompt for prefill,
-    T=1 for decode — same code path, same executable shape per T."""
+    T=1 for decode — same code path, same executable shape per T.
+    ``ffn(x, lp) -> x`` overrides the feed-forward sublayer (MoE)."""
     b, t = tokens.shape
     hd = cfg.head_dim
+    if ffn is None:
+        ffn = lambda x, lp: _dense_ffn(x, lp, cfg)   # noqa: E731
     kv_int8 = "k_scale" in cache
     x = jnp.take(params["embed"], tokens, axis=0)
     q_pos = pos_offset + jnp.arange(t)
@@ -142,9 +154,7 @@ def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
     def finish(x, o, lp):
         o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
         x = x + (o @ lp["wo"]).astype(x.dtype)
-        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-        return x + (up @ lp["w_down"]).astype(x.dtype)
+        return ffn(x, lp)
 
     if kv_int8:
         def layer(x, xs):
@@ -189,39 +199,45 @@ def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
 
 def prefill(params: dict, prompt: jax.Array, cfg: LlamaConfig,
             max_len: int | None = None,
-            kv_int8: bool = False) -> tuple[jax.Array, dict]:
+            kv_int8: bool = False, ffn=None) -> tuple[jax.Array, dict]:
     """Process the whole prompt [B, T]; returns (last-position logits
     [B, vocab], primed cache)."""
     cache = init_kv_cache(cfg, prompt.shape[0], max_len,
                           kv_int8=kv_int8)
     logits, cache = _forward_with_cache(
-        params, prompt, cache, jnp.int32(0), cfg)
+        params, prompt, cache, jnp.int32(0), cfg, ffn=ffn)
     return logits[:, -1], cache
 
 
 def decode_step(params: dict, cache: dict, token: jax.Array,
-                pos: jax.Array, cfg: LlamaConfig
+                pos: jax.Array, cfg: LlamaConfig, ffn=None
                 ) -> tuple[jax.Array, dict]:
     """One token in, next-token logits out.  token: [B], pos: scalar
     global position of ``token``."""
     logits, cache = _forward_with_cache(
-        params, token[:, None], cache, pos, cfg)
+        params, token[:, None], cache, pos, cfg, ffn=ffn)
     return logits[:, 0], cache
 
 
 @functools.lru_cache(maxsize=64)
 def _generate_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
-                 kv_int8: bool = False):
+                 kv_int8: bool = False, ffn_factory=None, ffn_cfg=None):
     """One compiled executable per (config, prompt len, steps, cache len)
     — repeat generations with the same shapes hit XLA's cache instead of
     re-tracing (the jit cache is keyed on the function object, so it must
-    be created once per static signature, not per call)."""
+    be created once per static signature, not per call).
+
+    ``ffn_factory(ffn_cfg)`` (both hashable, so they key the cache)
+    builds a feed-forward override for the cached forward — how the MoE
+    family reuses this machinery with routed experts."""
+    ffn = ffn_factory(ffn_cfg) if ffn_factory is not None else None
 
     @jax.jit
     def run(params, prompt):
         return _rollout(params, prompt, cfg, t, n_steps, max_len,
                         kv_int8,
-                        pick=lambda logits, i: jnp.argmax(logits, -1))
+                        pick=lambda logits, i: jnp.argmax(logits, -1),
+                        ffn=ffn)
 
     return run
 
@@ -277,7 +293,7 @@ def _validate_rollout(cfg: LlamaConfig, t: int, n_steps: int,
 
 
 def _rollout(params, prompt, cfg: LlamaConfig, t: int, n_steps: int,
-             max_len: int, kv_int8: bool, pick):
+             max_len: int, kv_int8: bool, pick, ffn=None):
     """THE decode loop — prefill, then ``n_steps - 1`` scanned decode
     forwards (the prefill already yields the first token's logits, the
     last token needs no successor) — shared by greedy and sampled
@@ -285,12 +301,13 @@ def _rollout(params, prompt, cfg: LlamaConfig, t: int, n_steps: int,
     never diverge between them.  ``pick(logits, step_index)`` is the
     trace-time-static token-selection rule."""
     logits, cache = prefill(params, prompt, cfg, max_len,
-                            kv_int8=kv_int8)
+                            kv_int8=kv_int8, ffn=ffn)
     first = pick(logits, 0).astype(prompt.dtype)
 
     def step(carry, i):
         token, cache = carry
-        logits, cache = decode_step(params, cache, token, t + i, cfg)
+        logits, cache = decode_step(params, cache, token, t + i, cfg,
+                                    ffn=ffn)
         nxt = pick(logits, i + 1).astype(token.dtype)
         return (nxt, cache), nxt
 

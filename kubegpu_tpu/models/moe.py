@@ -40,6 +40,7 @@ from kubegpu_tpu.models.llama import (
     LlamaConfig, _rmsnorm, attention_sublayer, make_train_step,
     select_attend,
 )
+from kubegpu_tpu.models import decode
 from kubegpu_tpu.parallel.sharding import constrain
 
 
@@ -269,3 +270,55 @@ def make_moe_train_step(cfg: MoEConfig, optimizer,
     llama train-step machinery with the MoE (lm + aux) loss."""
     return make_train_step(cfg, optimizer, mesh,
                            loss_fn=moe_next_token_loss)
+
+
+# ---------------------------------------------------------------------------
+# Serving: KV-cache decode with routed experts
+# ---------------------------------------------------------------------------
+
+def _moe_decode_ffn(cfg: MoEConfig):
+    """The routed-FFN hook for the cached forward (decode.py): same
+    moe_ffn as training, aux loss discarded (serving doesn't train the
+    router), no mesh constraints (single-host serving; GSPMD shardings
+    still flow from the params when present).
+
+    Capacity semantics: routing groups are per-call (the whole prompt
+    at prefill, ONE token per decode step), so capacity-overflow drops
+    differ from training's full-sequence grouping.  In the no-drop
+    regime (generous capacity_factor — how MoE serving is run in
+    practice, since dropping at inference is lossy) decode matches
+    moe_forward exactly; with tight capacity the decode path drops
+    LESS than training would."""
+    def ffn(x, lp):
+        h = _rmsnorm(x, lp["mlp_norm"], cfg.base.norm_eps)
+        y, _ = moe_ffn(h, lp, cfg, mesh=None)
+        return x + y
+    return ffn
+
+
+def moe_prefill(params: dict, prompt, cfg: MoEConfig,
+                max_len: int | None = None, kv_int8: bool = False):
+    """MoE counterpart of decode.prefill: (last logits, primed cache)."""
+    return decode.prefill(params, prompt, cfg.base, max_len,
+                          kv_int8=kv_int8, ffn=_moe_decode_ffn(cfg))
+
+
+def moe_decode_step(params: dict, cache: dict, token, pos,
+                    cfg: MoEConfig):
+    """One routed decode step: token [B], pos scalar → (logits, cache)."""
+    return decode.decode_step(params, cache, token, pos, cfg.base,
+                              ffn=_moe_decode_ffn(cfg))
+
+
+def moe_greedy_generate(params: dict, prompt, n_steps: int,
+                        cfg: MoEConfig, max_len: int | None = None,
+                        kv_int8: bool = False):
+    """Greedy decode for the MoE family — decode's shared compile-cache
+    + rollout machinery with the routed-expert FFN swapped in via the
+    hashable (factory, cfg) pair; per-step routing runs over each
+    step's single token (capacity top_k at T=1)."""
+    t = prompt.shape[1]
+    max_len = decode._validate_rollout(cfg.base, t, n_steps, max_len)
+    return decode._generate_fn(cfg.base, t, n_steps, max_len, kv_int8,
+                               ffn_factory=_moe_decode_ffn,
+                               ffn_cfg=cfg)(params, prompt)
