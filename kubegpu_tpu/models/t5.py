@@ -22,6 +22,7 @@ exercised the reference.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, replace
 
 import jax
@@ -333,3 +334,137 @@ def make_t5_train_step(cfg: T5Config, optimizer,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
     return step
+
+
+# ---------------------------------------------------------------------------
+# Serving: cached greedy decode (self-attn KV cache + precomputed
+# cross-attention K/V)
+# ---------------------------------------------------------------------------
+
+def t5_init_decode_state(params: dict, enc_out: jax.Array,
+                         cfg: T5Config, max_len: int) -> dict:
+    """Decoder serving state: zeroed self-attn KV cache
+    [L, B, H, max_len, D] plus the cross-attention K/V projected ONCE
+    from the encoder output (it never changes during decode — the
+    classic enc-dec serving optimization)."""
+    b = enc_out.shape[0]
+    hd = cfg.head_dim
+    nd = cfg.n_dec_layers
+
+    def project(w):   # [L, D_model, H*hd] over enc_out [B, S, D_model]
+        y = jnp.einsum("bsd,ldh->lbsh", enc_out, w)
+        return y.reshape(nd, b, enc_out.shape[1], cfg.n_heads, hd) \
+                .transpose(0, 1, 3, 2, 4)      # [L, B, H, S_enc, hd]
+
+    shape = (nd, b, cfg.n_heads, max_len, hd)
+    return {
+        "k": jnp.zeros(shape, cfg.jdtype),
+        "v": jnp.zeros(shape, cfg.jdtype),
+        "cross_k": project(params["decoder"]["ck"]),
+        "cross_v": project(params["decoder"]["cv"]),
+    }
+
+
+def _decode_rel_bias(table: jax.Array, pos, s: int,
+                     cfg: T5Config) -> jax.Array:
+    """[H, 1, S] causal rel-pos bias for a single query at ``pos``."""
+    rel = jnp.arange(s) - pos                  # memory - query
+    bucket = rel_pos_bucket(rel, False, cfg.rel_buckets,
+                            cfg.rel_max_dist)
+    return jnp.take(table, bucket, axis=0).T[:, None, :]   # [H, 1, S]
+
+
+def t5_decode_step(params: dict, state: dict, token: jax.Array,
+                   pos, cfg: T5Config) -> tuple[jax.Array, dict]:
+    """One decoder token in, next-token logits out.  token: [B]; pos:
+    scalar global decoder position of ``token``."""
+    b = token.shape[0]
+    hd = cfg.head_dim
+    s = state["k"].shape[3]
+    x = jnp.take(params["embed"], token[:, None], axis=0)  # [B, 1, D]
+    self_bias = _decode_rel_bias(params["dec_rel"], pos, s, cfg)
+    k_pos = jnp.arange(s)
+
+    def layer(x, xs):
+        lp, ck, cv, xk, xv = xs
+        # self-attention over the cache (causal via k_pos <= pos)
+        h = _rmsnorm(x, lp["self_norm"], cfg.norm_eps)
+        q = (h @ lp["sq"]).reshape(b, 1, cfg.n_heads, hd)
+        k = (h @ lp["sk"]).reshape(b, 1, cfg.n_heads, hd)
+        v = (h @ lp["sv"]).reshape(b, 1, cfg.n_heads, hd)
+        ck = lax.dynamic_update_slice(
+            ck, k.transpose(0, 2, 1, 3).astype(ck.dtype),
+            (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(
+            cv, v.transpose(0, 2, 1, 3).astype(cv.dtype),
+            (0, 0, pos, 0))
+        scores = jnp.einsum("bthd,bhsd->bhts", q, ck,
+                            preferred_element_type=jnp.float32) \
+            * hd ** -0.5
+        scores = scores + self_bias[None].astype(jnp.float32)
+        scores = jnp.where((k_pos <= pos)[None, None, None],
+                           scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhts,bhsd->bthd", probs, cv,
+                       preferred_element_type=jnp.float32)
+        o = o.astype(x.dtype).reshape(b, 1, cfg.n_heads * hd)
+        x = x + (o @ lp["so"]).astype(x.dtype)
+        # cross-attention over the precomputed encoder K/V (no bias)
+        h = _rmsnorm(x, lp["cross_norm"], cfg.norm_eps)
+        q = (h @ lp["cq"]).reshape(b, 1, cfg.n_heads, hd)
+        scores = jnp.einsum("bthd,bhsd->bhts", q, xk,
+                            preferred_element_type=jnp.float32) \
+            * hd ** -0.5
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhts,bhsd->bthd", probs, xv,
+                       preferred_element_type=jnp.float32)
+        o = o.astype(x.dtype).reshape(b, 1, cfg.n_heads * hd)
+        x = x + (o @ lp["co"]).astype(x.dtype)
+        x = _ffn(x, lp, cfg, None)
+        return x, (ck, cv)
+
+    x, (ck_new, cv_new) = lax.scan(
+        layer, x, (params["decoder"], state["k"], state["v"],
+                   state["cross_k"], state["cross_v"]))
+    x = _rmsnorm(x, params["dec_final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    state = {**state, "k": ck_new, "v": cv_new}
+    return logits[:, 0], state
+
+
+@functools.lru_cache(maxsize=16)
+def _t5_generate_fn(cfg: T5Config, s_enc: int, n_steps: int,
+                    max_len: int):
+    @jax.jit
+    def run(params, enc_tokens, start_token):
+        enc_out = t5_encode(params, enc_tokens, cfg)
+        state = t5_init_decode_state(params, enc_out, cfg, max_len)
+
+        def step(carry, i):
+            token, state = carry
+            logits, state = t5_decode_step(params, state, token, i, cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
+            return (nxt, state), nxt
+
+        (_, _), toks = lax.scan(
+            step, (start_token, state), jnp.arange(n_steps))
+        return toks.swapaxes(0, 1)     # [B, n_steps]
+
+    return run
+
+
+def t5_greedy_generate(params: dict, enc_tokens: jax.Array,
+                       n_steps: int, cfg: T5Config,
+                       start_token: int = 0,
+                       max_len: int | None = None) -> jax.Array:
+    """Encoder-decoder greedy generation: encode once, precompute the
+    cross K/V, then one scanned decode loop from ``start_token`` (T5's
+    decoder-start convention, default id 0).  Returns [B, n_steps]."""
+    max_len = max_len or n_steps
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    if n_steps > max_len:
+        raise ValueError(f"n_steps {n_steps} > max_len {max_len}")
+    start = jnp.full((enc_tokens.shape[0],), start_token, jnp.int32)
+    return _t5_generate_fn(cfg, enc_tokens.shape[1], n_steps, max_len)(
+        params, enc_tokens, start)
