@@ -9,6 +9,7 @@ from kubegpu_tpu.models.decode import (
     greedy_generate,
     init_kv_cache,
     sample_generate,
+    spec_generate,
     prefill,
 )
 from kubegpu_tpu.models.llama import (
@@ -58,7 +59,7 @@ __all__ = [
     "t5_greedy_generate", "t5_decode_step", "t5_init_decode_state",
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
-    "sample_generate", "beam_generate",
+    "sample_generate", "beam_generate", "spec_generate",
     "QTensor", "quantize_llama",
     "LoRAConfig", "lora_init", "lora_merge", "lora_param_specs",
     "make_lora_train_step",

@@ -277,3 +277,77 @@ class TestBeamSearch:
         prompt = jnp.zeros((1, 4), jnp.int32)
         with pytest.raises(ValueError, match="beams"):
             beam_generate(params, prompt, 2, cfg, beams=0)
+
+
+class TestSpeculative:
+    def test_output_identical_to_greedy(self, tiny):
+        """THE speculative-decoding contract: the draft decides how many
+        tokens each full forward yields, never which."""
+        from kubegpu_tpu.models.decode import spec_generate
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 6, dtype=jnp.int32).reshape(2, 6) * 7
+                  ) % cfg.vocab_size
+        for n in (1, 2, 9):
+            greedy = np.asarray(greedy_generate(params, prompt, n, cfg))
+            for dl, g in ((1, 4), (2, 2), (3, 3)):
+                toks, _ = spec_generate(params, prompt, n, cfg,
+                                        draft_layers=dl, gamma=g)
+                np.testing.assert_array_equal(
+                    np.asarray(toks), greedy,
+                    err_msg=f"n={n} draft_layers={dl} gamma={g}")
+
+    def test_perfect_draft_accepts_everything(self, tiny):
+        """draft_layers == n_layers: the draft IS the model, so every
+        proposal matches and acceptance saturates at (gamma-1)/gamma
+        (the g-th token is emitted as the correction by design)."""
+        from kubegpu_tpu.models.decode import spec_generate
+        cfg, params = tiny
+        prompt = (jnp.arange(5, dtype=jnp.int32)[None] * 3
+                  ) % cfg.vocab_size
+        toks, stats = spec_generate(params, prompt, 12, cfg,
+                                    draft_layers=cfg.n_layers, gamma=4)
+        greedy = np.asarray(greedy_generate(params, prompt, 12, cfg))
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
+        # every iteration advances by gamma tokens (g-1 accepted + 1)
+        assert stats["iterations"] <= -(-12 // 4) + 1
+        assert stats["acceptance_rate"] >= 0.6
+
+    def test_kv_int8_and_stats(self, tiny):
+        from kubegpu_tpu.models.decode import spec_generate
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5)
+                  ) % cfg.vocab_size
+        toks, stats = spec_generate(params, prompt, 6, cfg,
+                                    draft_layers=1, gamma=3,
+                                    kv_int8=True)
+        assert toks.shape == (2, 6)
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0
+        assert stats["iterations"] >= 1
+
+    def test_validation(self, tiny):
+        from kubegpu_tpu.models.decode import spec_generate
+        cfg, params = tiny
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="draft_layers"):
+            spec_generate(params, prompt, 2, cfg, draft_layers=0)
+        with pytest.raises(ValueError, match="gamma"):
+            spec_generate(params, prompt, 2, cfg, draft_layers=1,
+                          gamma=0)
+
+    def test_quantized_params_supported(self, tiny):
+        """int8 weight trees (QTensor leaves) must slice into the draft
+        view and decode — the quant.py drop-in contract extends to
+        speculative decoding."""
+        from kubegpu_tpu.models.decode import draft_view, spec_generate
+        from kubegpu_tpu.models.quant import quantize_llama
+        cfg, params = tiny
+        qparams = quantize_llama(params)
+        dview = draft_view(qparams, 2)
+        assert dview["layers"]["wq"].values.shape[0] == 2
+        prompt = (jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5)
+                  ) % cfg.vocab_size
+        toks, _ = spec_generate(qparams, prompt, 4, cfg,
+                                draft_layers=2, gamma=2,
+                                dparams=dview)
+        greedy = np.asarray(greedy_generate(qparams, prompt, 4, cfg))
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
