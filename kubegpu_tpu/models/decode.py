@@ -561,7 +561,17 @@ def spec_generate(params: dict, prompt: jax.Array, n_steps: int,
     iterations = 0
     proposed = accepted_total = 0
     while len(out) < n_steps:
-        g = min(gamma, n_steps - len(out))
+        remaining = n_steps - len(out)
+        if remaining == 1:
+            # a draft proposal can't help (take caps at 0): one plain
+            # full-model step, reusing the T=1 verify executable
+            vlogits, full_cache = verify(params, full_cache,
+                                         cur[:, None], jnp.int32(pos))
+            out.append(jnp.argmax(vlogits[:, 0], axis=-1)
+                       .astype(cur.dtype))
+            iterations += 1
+            break
+        g = min(gamma, remaining)
         # draft proposes g tokens from `cur`
         d_toks = []
         dtok = cur

@@ -1,0 +1,73 @@
+"""Llama serving workload — decode as a SCHEDULABLE job, not just a
+library call: the pod runs prefill + greedy decode on its allocated
+chip(s) and prints a metric line the node agent harvests into the
+cluster registry (like the allreduce bench does for north-star #2).
+
+Env knobs:
+  SERVE_BATCH    sequences (default 4)
+  SERVE_PROMPT   prompt length (default 128)
+  SERVE_STEPS    decode steps (default 32)
+  SERVE_INT8     "1" quantizes weights AND KV cache (default 0)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    from kubegpu_tpu.workloads.programs.distributed import init_from_env
+
+    env = init_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.models import (
+        LlamaConfig, greedy_generate, llama_init, quantize_llama,
+    )
+
+    batch = int(os.environ.get("SERVE_BATCH", "4"))
+    prompt_t = int(os.environ.get("SERVE_PROMPT", "128"))
+    steps = int(os.environ.get("SERVE_STEPS", "32"))
+    int8 = os.environ.get("SERVE_INT8", "0") == "1"
+
+    cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, dtype="float32",
+                           max_seq_len=prompt_t + steps)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    if int8:
+        params = quantize_llama(params)
+    prompt = jnp.asarray(
+        np.arange(batch * prompt_t).reshape(batch, prompt_t)
+        % cfg.vocab_size, jnp.int32)
+
+    out = greedy_generate(params, prompt, steps, cfg,
+                          max_len=prompt_t + steps, kv_int8=int8)
+    jax.block_until_ready(out)           # warm + compile
+    t0 = time.perf_counter()
+    out = greedy_generate(params, prompt, steps, cfg,
+                          max_len=prompt_t + steps, kv_int8=int8)
+    first = int(np.asarray(out)[0, 0])   # host fetch = real barrier
+    elapsed = time.perf_counter() - t0
+
+    ok = 0 <= first < cfg.vocab_size
+    if env.worker_id == 0:
+        # the metric-line convention harvest_workload_metrics consumes
+        print(json.dumps({
+            "metric": "serve_decode_tokens_per_s",
+            "value": round(batch * steps / elapsed, 1),
+            "unit": "tokens/s",
+            "batch": batch, "prompt": prompt_t, "steps": steps,
+            "int8": int8, "devices": jax.device_count(),
+        }))
+    if not ok:
+        print("FAIL: generated token out of range", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -105,6 +105,14 @@ def t5_seq2seq(slice_type: str = "v4-8") -> tuple[list[Pod], list[str]]:
     return pods, [slice_type]
 
 
+def llama_serving(slice_type: str = "v4-8") -> tuple[list[Pod], list[str]]:
+    """Serving as a schedulable workload: a 1-chip pod runs KV-cache
+    decode and reports its tokens/s as a harvestable metric line."""
+    pods = [tpu_pod("llama-serve", chips=1, command=_prog("llama_serve"),
+                    env={"SERVE_STEPS": "16"})]
+    return pods, [slice_type]
+
+
 ALL_CONFIGS = {
     "config1": config1_cpu_mnist,
     "config2": config2_resnet_1chip,
@@ -113,4 +121,5 @@ ALL_CONFIGS = {
     "config5": config5_multitenant,
     "allreduce": allreduce_gang,
     "t5": t5_seq2seq,
+    "serve": llama_serving,
 }

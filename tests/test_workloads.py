@@ -131,3 +131,23 @@ class TestT5Workload:
             assert "losses=" in out
         finally:
             cl.close()
+
+
+@pytest.mark.slow
+class TestServingWorkload:
+    def test_serve_metric_lands_in_registry(self):
+        """Serving runs as a scheduled pod; its tokens/s metric line is
+        harvested into the cluster registry like the allreduce bench."""
+        pods, slice_types = specs.llama_serving()
+        cl = SimCluster(slice_types, real_processes=True,
+                        extra_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            cl.submit(*pods)
+            codes = cl.run_to_completion(timeout_s=300)
+            assert codes == {"llama-serve": 0}, (
+                codes,
+                cl.api.get("Pod", "llama-serve").status.message)
+            snap = cl.metrics.snapshot()
+            assert snap["gauges"]["workload_serve_decode_tokens_per_s"] > 0
+        finally:
+            cl.close()
