@@ -11,6 +11,7 @@ caller works identically on CPU tests and TPU benches.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +69,10 @@ BLOCK_K_BWD = 512
 # per-row broadcasts need no transpose), a full size-8 lane dim to
 # satisfy the TPU (8, 128)-or-full block rule at f32 tiling.
 LSE_LANES = 8
+# Resident q/do/lse/delta panel budget for the grouped dkv backward
+# kernel (see flash_attention_bwd): beyond this the geometry de-groups
+# via repeat_kv instead of risking a scoped-vmem compile error.
+DKV_PANEL_BUDGET = 6 * 1024 * 1024
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -77,9 +82,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_k: int = BLOCK_K,
                     interpret: bool = False,
                     return_lse: bool = False):
-    """Pallas flash attention.  Shapes as ``xla_attention`` (GQA folded
-    by repeating kv heads before the kernel — the bandwidth win of true
-    grouped reads is a later-round optimization).
+    """Pallas flash attention with *grouped* GQA reads.
+
+    Shapes as ``xla_attention``.  K/V are NOT repeated up to the query
+    head count: the grid is (b·hkv, group, q-blocks) and the K/V block
+    index maps are constant across the ``group`` dimension, so the
+    pallas pipeline fetches each (b, kv-head) K/V panel from HBM once
+    and reuses it for all ``group`` query heads — K/V HBM traffic drops
+    by the GQA group factor vs the repeat_kv formulation, and the 4×
+    repeated K/V copies are never materialized at all.
 
     With ``return_lse`` also returns the per-row logsumexp ``L`` of
     shape [B, Hq, T] (f32) — the residual the backward kernels need.
@@ -92,7 +103,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"causal attention with more queries ({t}) than keys ({s}) is "
             "ill-defined (queries before the key horizon attend nothing)")
-    k, v = repeat_kv(q, k, v)
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
     scale = d ** -0.5
     causal_offset = s - t  # end-aligned, matching xla_attention
     block_q = min(block_q, t)
@@ -103,13 +116,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             return out
         return out, _xla_lse(q, k, causal, scale)
 
+    # q head h = kv head (h // group), query-group (h % group) — the
+    # same consecutive-repeat convention as ``repeat_kv``.
     qf = q.reshape(b * hq, t, d)
-    kf = k.reshape(b * hq, s, d)
-    vf = v.reshape(b * hq, s, d)
+    kf = k.reshape(b * hkv, s, d)
+    vf = v.reshape(b * hkv, s, d)
     num_k_blocks = s // block_k
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None):
-        qi = pl.program_id(1)
+        qi = pl.program_id(2)
         qb = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
 
         def body(ki, carry):
@@ -157,22 +172,28 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             lse_ref[0] = jnp.broadcast_to(m_acc + jnp.log(l_safe),
                                           (block_q, LSE_LANES))
 
-    grid = (b * hq, t // block_q)
+    # K/V index maps ignore (g, j): consecutive grid steps within one
+    # (b, kv-head) see the same block index, so pallas keeps the panel
+    # resident in VMEM instead of re-fetching it per query head.
+    grid = (b * hkv, group, t // block_q)
+    q_spec = pl.BlockSpec((1, block_q, d),
+                          lambda i, g, j: (i * group + g, j, 0))
     out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))]
+    out_specs = [q_spec]
     if return_lse:   # inference forwards skip the extra f32 HBM output
         out_shape.append(
             jax.ShapeDtypeStruct((b * hq, t, LSE_LANES), jnp.float32))
         out_specs.append(
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j: (i, j, 0)))
+            pl.BlockSpec((1, block_q, LSE_LANES),
+                         lambda i, g, j: (i * group + g, j, 0)))
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            q_spec,
+            pl.BlockSpec((1, s, d), lambda i, g, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, g, j: (i, 0, 0)),
         ],
         out_specs=out_specs,
         interpret=interpret,
@@ -189,6 +210,8 @@ def _xla_lse(q, k, causal, scale):
     version of the kernel's L output."""
     b, hq, t, d = q.shape
     s = k.shape[2]
+    if hq != k.shape[1]:
+        k = jnp.repeat(k, hq // k.shape[1], axis=1)
     scores = jnp.einsum("bhtd,bhsd->bhts", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
@@ -208,14 +231,22 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
 
     Two kernels (the standard TPU split, avoiding cross-grid-step
     accumulation races): dq iterates k-blocks per q-block; dk/dv
-    iterates q-blocks per k-block.  Requires Hq == Hkv (callers repeat
-    kv heads first) and block-tiling shapes (callers fall back to the
-    XLA VJP otherwise).
+    iterates q-blocks per k-block.  GQA runs *grouped* like the
+    forward: K/V stay at Hkv heads, the dq grid carries a group
+    dimension with group-constant K/V index maps, and the dkv kernel
+    statically unrolls the group so dk/dv are summed over the query
+    group in-kernel (returning [B, Hkv, S, D] directly — no repeated
+    dk/dv materialization + XLA reduction afterwards).  Requires
+    block-tiling shapes (callers fall back to the XLA VJP otherwise).
     """
     from jax.experimental import pallas as pl
 
     b, h, t, d = q.shape
+    hkv = k.shape[1]
     s = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {hkv}")
+    group = h // hkv
     scale = d ** -0.5
     causal_offset = s - t
     block_q = min(block_q, t)
@@ -223,10 +254,29 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
     assert t % block_q == 0 and s % block_k == 0
     num_k_blocks = s // block_k
     num_q_blocks = t // block_q
+    # Geometries whose resident [group·t, d] panels can't fit the dkv
+    # kernel's VMEM (e.g. group 8 · t 4096) de-group THAT kernel only:
+    # K/V repeat up to the query head count for the dkv call (paying
+    # its extra HBM traffic — better than a scoped-vmem compile
+    # error) and dk/dv are summed over the group afterwards.  The dq
+    # kernel's layout is per-query-head regardless, so it stays
+    # grouped either way.
+    panel_bytes = group * t * (q.dtype.itemsize * 2 * d
+                               + 2 * LSE_LANES * 4)
+    degroup_kv = group > 1 and panel_bytes > DKV_PANEL_BUDGET
+    group_kv = 1 if degroup_kv else group
+    # The grouped dkv kernel keeps the whole [group·t, d] q/do panels
+    # resident in VMEM; at group 4 / t 2048 / d 128 that plus 512-tall
+    # score scratch overflows the 16 MiB scoped-vmem limit (measured:
+    # 16.28M > 16.00M), so its q-block caps at 256 when grouped —
+    # gcd against t so an arbitrary caller block (e.g. 384) can never
+    # truncate rows out of the dk/dv accumulation.
+    block_q_kv = math.gcd(t, min(block_q, 256)) if group_kv > 1 else block_q
+    num_q_blocks_kv = t // block_q_kv
 
     qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, s, d)
-    vf = v.reshape(b * h, s, d)
+    kf = k.reshape(b * hkv, s, d)
+    vf = v.reshape(b * hkv, s, d)
     dof = do.reshape(b * h, t, d)
     lsef = jnp.broadcast_to(
         lse.reshape(b * h, t, 1), (b * h, t, LSE_LANES))
@@ -237,7 +287,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
 
     def dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
                   dq_ref):
-        qi = pl.program_id(1)
+        qi = pl.program_id(2)
         qb = q_ref[0].astype(jnp.float32)            # [bq, d]
         dob = do_ref[0].astype(jnp.float32)          # [bq, d]
         lse_b = lse_ref[0][:, 0:1]                   # [bq, 1]
@@ -276,93 +326,124 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
 
     def dkv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
                    dk_ref, dv_ref):
+        # q/do/lse/delta arrive as the full [group·t, ...] panel for
+        # this (b, kv-head); row g·t + i is query head g's row i.
         ki = pl.program_id(1)
         kb = k_ref[0].astype(jnp.float32)            # [bk, d]
         vb = v_ref[0].astype(jnp.float32)            # [bk, d]
 
-        def body(qi, carry):
-            dk_acc, dv_acc = carry
-            qb = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-            dob = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(
-                jnp.float32)
-            lse_b = lse_ref[0, pl.ds(qi * block_q, block_q), 0:1]
-            delta_b = delta_ref[0, pl.ds(qi * block_q, block_q), 0:1]
-            sc = jax.lax.dot_general(
-                qb * scale, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [bq, bk]
-            if causal:
-                qpos = causal_offset + qi * block_q + \
-                    jax.lax.broadcasted_iota(
-                        jnp.int32, (block_q, block_k), 0)
-                kpos = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                sc = jnp.where(qpos >= kpos, sc, NEG_INF)
-            p = jnp.exp(sc - lse_b)                  # [bq, bk]
-            dv_new = dv_acc + jax.lax.dot_general(
-                p, dob, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [bk, d]
-            dp = jax.lax.dot_general(
-                dob, vb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [bq, bk]
-            ds = p * (dp - delta_b) * scale
-            dk_new = dk_acc + jax.lax.dot_general(
-                ds, qb, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [bk, d]
-            return dk_new, dv_new
+        def make_body(goff):
+            def body(qi, carry):
+                dk_acc, dv_acc = carry
+                rows = pl.ds(goff + qi * block_q_kv, block_q_kv)
+                qb = q_ref[0, rows, :].astype(jnp.float32)
+                dob = do_ref[0, rows, :].astype(jnp.float32)
+                lse_b = lse_ref[0, rows, 0:1]
+                delta_b = delta_ref[0, rows, 0:1]
+                sc = jax.lax.dot_general(
+                    qb * scale, kb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [bq, bk]
+                if causal:
+                    qpos = causal_offset + qi * block_q_kv + \
+                        jax.lax.broadcasted_iota(
+                            jnp.int32, (block_q_kv, block_k), 0)
+                    kpos = ki * block_k + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q_kv, block_k), 1)
+                    sc = jnp.where(qpos >= kpos, sc, NEG_INF)
+                p = jnp.exp(sc - lse_b)                  # [bq, bk]
+                dv_new = dv_acc + jax.lax.dot_general(
+                    p, dob, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [bk, d]
+                dp = jax.lax.dot_general(
+                    dob, vb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [bq, bk]
+                ds = p * (dp - delta_b) * scale
+                dk_new = dk_acc + jax.lax.dot_general(
+                    ds, qb, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [bk, d]
+                return dk_new, dv_new
+            return body
 
         if causal:
             # q-blocks whose whole range sits before this k-block's
             # first visible query contribute nothing; -1 keeps the
             # bound conservative (masking zeroes any extra block)
             lo = jnp.maximum(
-                0, (ki * block_k - causal_offset) // block_q - 1)
+                0, (ki * block_k - causal_offset) // block_q_kv - 1)
         else:
             lo = 0
-        dk, dv = jax.lax.fori_loop(
-            lo, num_q_blocks, body,
-            (jnp.zeros((block_k, d), jnp.float32),
-             jnp.zeros((block_k, d), jnp.float32)))
+        dk = jnp.zeros((block_k, d), jnp.float32)
+        dv = jnp.zeros((block_k, d), jnp.float32)
+        for g in range(group_kv):   # static unroll: sum the query group
+            dk, dv = jax.lax.fori_loop(lo, num_q_blocks_kv,
+                                       make_body(g * t), (dk, dv))
         dk_ref[0] = dk.astype(dk_ref.dtype)
         dv_ref[0] = dv.astype(dv_ref.dtype)
 
+    qh_spec = pl.BlockSpec((1, block_q, d),
+                           lambda i, g, j: (i * group + g, j, 0))
+    lseh_spec = pl.BlockSpec((1, block_q, LSE_LANES),
+                             lambda i, g, j: (i * group + g, j, 0))
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
-        grid=(b * h, num_q_blocks),
+        grid=(b * hkv, group, num_q_blocks),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            qh_spec,
+            pl.BlockSpec((1, s, d), lambda i, g, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, g, j: (i, 0, 0)),
+            lseh_spec,
+            lseh_spec,
+            qh_spec,
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=qh_spec,
         interpret=interpret,
     )(qf, kf, vf, lsef, delta, dof)
+    # dkv reads the whole query group per (b, kv-head): view the
+    # [b·h, t, ...] panels as [b·hkv, group·t, ...] (free reshape).
+    # De-grouped, every view keeps one query head per row block and
+    # K/V repeat up to b·h heads.
+    heads_kv = b * h if degroup_kv else b * hkv
+    if degroup_kv:
+        kkv = jnp.repeat(k, group, axis=1).reshape(b * h, s, d)
+        vkv = jnp.repeat(v, group, axis=1).reshape(b * h, s, d)
+    else:
+        kkv, vkv = kf, vf
+    qg = qf.reshape(heads_kv, group_kv * t, d)
+    dog = dof.reshape(heads_kv, group_kv * t, d)
+    lseg = lsef.reshape(heads_kv, group_kv * t, LSE_LANES)
+    deltag = delta.reshape(heads_kv, group_kv * t, LSE_LANES)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=[
-            jax.ShapeDtypeStruct(kf.shape, k.dtype),
-            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+            jax.ShapeDtypeStruct(kkv.shape, k.dtype),
+            jax.ShapeDtypeStruct(vkv.shape, v.dtype),
         ],
-        grid=(b * h, num_k_blocks),
+        grid=(heads_kv, num_k_blocks),
         in_specs=[
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, group_kv * t, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, LSE_LANES), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, LSE_LANES), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, group_kv * t, LSE_LANES),
+                         lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, group_kv * t, LSE_LANES),
+                         lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, group_kv * t, d), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         ],
         interpret=interpret,
-    )(qf, kf, vf, lsef, delta, dof)
-    return (dq.reshape(b, h, t, d), dk.reshape(b, h, s, d),
-            dv.reshape(b, h, s, d))
+    )(qg, kkv, vkv, lseg, deltag, dog)
+    if degroup_kv:   # sum the per-query-head dk/dv over each group
+        dk = dk.reshape(b, hkv, group, s, d).sum(
+            axis=2, dtype=jnp.float32).astype(k.dtype)
+        dv = dv.reshape(b, hkv, group, s, d).sum(
+            axis=2, dtype=jnp.float32).astype(v.dtype)
+        return dq.reshape(b, h, t, d), dk, dv
+    return (dq.reshape(b, h, t, d), dk.reshape(b, hkv, s, d),
+            dv.reshape(b, hkv, s, d))
 
 
 # ---------------------------------------------------------------------------
@@ -373,9 +454,9 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
 # residuals (flash attention's memory trade); backward recomputes scores
 # blockwise in the two kernels of :func:`flash_attention_bwd`.  Shapes
 # that don't tile the blocks fall back to differentiating the XLA
-# reference instead.  GQA is handled OUTSIDE this boundary: callers
-# repeat kv heads first, so JAX's own autodiff of the repeat sums
-# dk/dv over the query groups.
+# reference instead.  GQA stays *grouped* through this boundary: K/V
+# (and dk/dv) keep Hkv heads end-to-end — the dkv kernel sums over the
+# query group in-kernel.
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -428,6 +509,6 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl in ("pallas", "pallas_interpret"):
-        k, v = repeat_kv(q, k, v)   # GQA outside the custom-vjp boundary
+        # GQA stays grouped through the kernels — no repeat_kv
         return _flash_diff(q, k, v, causal, impl == "pallas_interpret")
     return xla_attention(q, k, v, causal=causal)

@@ -137,7 +137,8 @@ class TestFlashBackward:
     @pytest.mark.parametrize("causal", [True, False])
     def test_attention_dispatch_grads(self, causal):
         """End-to-end through attention(impl='pallas_interpret') — the
-        custom-vjp boundary, incl. GQA head-repeat outside it."""
+        custom-vjp boundary; GQA stays grouped through it (dk/dv come
+        back at Hkv heads, summed over the query group in-kernel)."""
         from kubegpu_tpu.ops.flash_attention import attention
         q, k, v = rand_qkv(jax.random.PRNGKey(4), hq=8, hkv=2,
                            t=128, s=128)
@@ -194,6 +195,87 @@ class TestFlashBackward:
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(r), atol=5e-4, rtol=5e-4,
                 err_msg=name)
+
+    def test_grouped_gqa_suffix_grads(self):
+        """GQA (group 4) with t < s: the grouped dkv kernel's row
+        offsets (g·t + qi·block_q) and the end-aligned causal bound
+        must compose — dk/dv come back at Hkv heads summed over the
+        query group in-kernel."""
+        from kubegpu_tpu.ops.flash_attention import attention
+        q, k, v = rand_qkv(jax.random.PRNGKey(12), hq=8, hkv=2,
+                           t=64, s=256)
+        ref = self._grads(
+            lambda a, b, c: xla_attention(a, b, c, causal=True),
+            q, k, v)
+        got = self._grads(
+            lambda a, b, c: attention(a, b, c, causal=True,
+                                      impl="pallas_interpret"),
+            q, k, v)
+        for g, r, name in zip(got, ref, ("dq", "dk", "dv")):
+            assert g.shape == r.shape, name
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=5e-4, rtol=5e-4,
+                err_msg=name)
+
+    def test_grouped_dkv_block_cap_divides_t(self):
+        """Regression (r3 review): a caller block_q of 384 passes the
+        t % block_q tiling assert, but the grouped dkv cap (256) must
+        be gcd'd against t — a plain min() would truncate rows 256+
+        out of the dk/dv accumulation silently (measured err ~2.4)."""
+        from kubegpu_tpu.ops.flash_attention import (
+            flash_attention,
+            flash_attention_bwd,
+        )
+        q, k, v = rand_qkv(jax.random.PRNGKey(13), hq=4, hkv=1,
+                           t=384, s=384, d=32)
+        out, lse = flash_attention(q, k, v, causal=True, block_q=384,
+                                   block_k=384, interpret=True,
+                                   return_lse=True)
+        w = jnp.arange(out.size, dtype=out.dtype).reshape(out.shape)
+        g = w / out.size
+        dq, dk, dv = flash_attention_bwd(
+            q, k, v, out, lse, g, causal=True, block_q=384,
+            block_k=384, interpret=True)
+        ref = self._grads(
+            lambda a, b, c: xla_attention(a, b, c, causal=True),
+            q, k, v)
+        for got, want, name in ((dq, ref[0], "dq"), (dk, ref[1], "dk"),
+                                (dv, ref[2], "dv")):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=5e-4, rtol=5e-4,
+                err_msg=name)
+
+    def test_grouped_dkv_panel_budget_degroups(self, monkeypatch):
+        """Geometries whose resident [group·t, d] panels exceed the
+        VMEM budget must take the repeat_kv de-group fallback (and
+        still return dk/dv at Hkv heads) instead of compiling a kernel
+        that overflows scoped vmem."""
+        import sys
+        # (`import kubegpu_tpu.ops.flash_attention` yields the jitted
+        # FUNCTION: the package __init__ rebinds the submodule name)
+        fa_mod = sys.modules["kubegpu_tpu.ops.flash_attention"]
+        monkeypatch.setattr(fa_mod, "DKV_PANEL_BUDGET", 1024)
+        # t=192: a shape no other test traces, so the jitted bwd cannot
+        # serve a pre-patch cache entry here
+        q, k, v = rand_qkv(jax.random.PRNGKey(14), hq=8, hkv=2,
+                           t=192, s=192)
+        try:
+            ref = self._grads(
+                lambda a, b, c: xla_attention(a, b, c, causal=True),
+                q, k, v)
+            got = self._grads(
+                lambda a, b, c: fa_mod.attention(a, b, c, causal=True,
+                                                 impl="pallas_interpret"),
+                q, k, v)
+            for g, r, name in zip(got, ref, ("dq", "dk", "dv")):
+                assert g.shape == r.shape, name
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(r), atol=5e-4, rtol=5e-4,
+                    err_msg=name)
+        finally:
+            # drop the traces that baked in the patched budget — later
+            # tests reusing this geometry must re-trace the real one
+            jax.clear_caches()
 
     def test_fwd_tiling_but_not_bwd_keeps_pallas(self):
         """t=768 tiles the forward's 256 blocks but not the backward's
