@@ -149,6 +149,31 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def embed_lookup(embed: jax.Array, tokens: jax.Array,
+                 mesh: Mesh | None) -> jax.Array:
+    """Token-embedding lookup.  Single-device: a plain gather.  Under a
+    sharded mesh, a one-hot contraction instead: SPMD cannot partition
+    a gather from a (tp-vocab, fsdp-d) sharded table against
+    (dp·fsdp, sp)-sharded indices — it falls back to "involuntary full
+    rematerialization" (all-gathering the whole table per step; the
+    spmd_partitioner.cc warnings in MULTICHIP_r02's tail).  The
+    one-hot matmul partitions cleanly — contraction over the
+    tp-sharded vocab dim becomes a local matmul + psum, and its
+    transpose (the embedding gradient) is again a matmul, not a
+    scatter-add.  Only meshes that actually shard the table (tp or
+    fsdp > 1) pay the one-hot materialization; a dp-only mesh keeps
+    the zero-comms gather.  Tokens are clipped like ``jnp.take``'s
+    default mode so out-of-range ids behave identically on both
+    paths (one_hot alone would silently embed them as zeros)."""
+    sharded = mesh is not None and any(
+        mesh.shape.get(a, 1) > 1 for a in ("tp", "fsdp"))
+    if not sharded:
+        return jnp.take(embed, tokens, axis=0)
+    tokens = jnp.clip(tokens, 0, embed.shape[0] - 1)
+    onehot = jax.nn.one_hot(tokens, embed.shape[0], dtype=embed.dtype)
+    return onehot @ embed
+
+
 def llama_forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
                   mesh: Mesh | None = None) -> jax.Array:
     """tokens [B, T] → logits [B, T, vocab] (f32).
@@ -159,7 +184,7 @@ def llama_forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     runs as a ppermute ring.
     """
     b, t = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_lookup(params["embed"], tokens, mesh)
     x = constrain(x, mesh, ("dp", "fsdp"), "sp", None)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
     attend = select_attend(cfg, mesh)

@@ -37,8 +37,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubegpu_tpu.models.llama import (
-    LlamaConfig, _rmsnorm, attention_sublayer, make_train_step,
-    select_attend,
+    LlamaConfig, _rmsnorm, attention_sublayer, embed_lookup,
+    make_train_step, select_attend,
 )
 from kubegpu_tpu.models import decode
 from kubegpu_tpu.parallel.sharding import constrain
@@ -233,7 +233,7 @@ def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
     """tokens [B,T] → (logits [B,T,V] f32, total aux loss)."""
     b = cfg.base
     bs, t = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_lookup(params["embed"], tokens, mesh)
     x = constrain(x, mesh, ("dp", "fsdp"), "sp", None)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (bs, t))
     attend = select_attend(b, mesh)

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from kubegpu_tpu.models.llama import _rmsnorm
+from kubegpu_tpu.models.llama import _rmsnorm, embed_lookup
 from kubegpu_tpu.ops.flash_attention import NEG_INF
 from kubegpu_tpu.parallel.sharding import constrain
 
@@ -258,7 +258,7 @@ def _ffn(x, lp, cfg, mesh):
 def t5_encode(params: dict, tokens: jax.Array, cfg: T5Config,
               mesh: Mesh | None = None) -> jax.Array:
     """tokens [B, S] → encoder states [B, S, d_model]."""
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_lookup(params["embed"], tokens, mesh)
     x = constrain(x, mesh, ("dp", "fsdp"), None, None)
     bias = _rel_bias(params["enc_rel"], tokens.shape[1], tokens.shape[1],
                      bidirectional=True, cfg=cfg)
@@ -278,7 +278,7 @@ def t5_decode_train(params: dict, enc_out: jax.Array,
                     dec_tokens: jax.Array, cfg: T5Config,
                     mesh: Mesh | None = None) -> jax.Array:
     """Teacher-forced decoder: [B, T] targets-in → logits [B, T, V]."""
-    x = jnp.take(params["embed"], dec_tokens, axis=0)
+    x = embed_lookup(params["embed"], dec_tokens, mesh)
     x = constrain(x, mesh, ("dp", "fsdp"), None, None)
     t = dec_tokens.shape[1]
     self_bias = _rel_bias(params["dec_rel"], t, t, bidirectional=False,
