@@ -184,19 +184,7 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
     else:
         batch, prompt_t, steps, iters = 2, 8, 4, 2
     max_len = prompt_t + steps
-
-    def timeit(fn, fetch, n):
-        out = fn()
-        _fetch_scalar(fetch(out))
-        rtt = _fetch_rtt_s(fetch(out))
-        best = float("inf")
-        for _ in range(2):   # best-of-2: tunnel noise only ever adds
-            t0 = time.perf_counter()
-            for _ in range(n):
-                out = fn()
-            _fetch_scalar(fetch(out))
-            best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
-        return best / n
+    timeit = _time_calls   # ONE timing protocol for every bench row
 
     def measure(p, b, n, kv_int8=False):
         """(prefill_s, decode_s) for params ``p`` at batch ``b`` — ONE
@@ -244,6 +232,193 @@ def _serving_bench(cfg, params, on_tpu) -> dict:
         "int8_kv_decode_tokens_per_s": tps(batch, qkv_decode_s),
         "int8_kv_decode_b4x_tokens_per_s": tps(batch * 4, qkv_b4x_s),
     }
+
+
+def moe_bench_config():
+    """MoE bench scale for one v5e chip: the flagship's attention
+    geometry (head_dim 128, GQA) at half width, 8 routed experts top-2
+    (~390M params — experts dominate)."""
+    from kubegpu_tpu.models import LlamaConfig
+    from kubegpu_tpu.models.moe import MoEConfig
+    return MoEConfig(
+        base=LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
+            n_kv_heads=2, d_ff=1536, max_seq_len=1024,
+            dtype="bfloat16", remat=False),
+        n_experts=8, top_k=2)
+
+
+def t5_bench_config():
+    """Encoder-decoder bench scale (~340M): t5-large-ish width, 8+8
+    layers."""
+    from kubegpu_tpu.models.t5 import T5Config
+    return T5Config(vocab_size=32000, d_model=1024, n_enc_layers=8,
+                    n_dec_layers=8, n_heads=16, d_ff=2816)
+
+
+def _time_calls(fn, fetch, n: int) -> float:
+    """Seconds per call of ``fn`` timed as n serial dispatches with one
+    end fetch (device execution is serial; per-call blocking is a no-op
+    under the async tunnel), best of 2 bursts, RTT subtracted."""
+    out = fn()
+    _fetch_scalar(fetch(out))
+    rtt = _fetch_rtt_s(fetch(out))
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        _fetch_scalar(fetch(out))
+        best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
+    return best / n
+
+
+def _families_bench(cfg, params, on_tpu) -> dict:
+    """Reproducible rows for every non-flagship BASELINE.md hardware
+    figure (VERDICT r2 weak #2: those numbers were session anecdotes no
+    committed harness could regenerate): MoE serving, T5 serving, LoRA
+    fine-tune step, beam search, speculative decode.  ``cfg``/``params``
+    are the flagship train bench's (the Llama-based rows reuse them).
+    On CPU the same code runs at tiny scale so tests cover the paths."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubegpu_tpu.models import LlamaConfig
+    from kubegpu_tpu.models.decode import (
+        beam_generate,
+        draft_view,
+        greedy_generate,
+        spec_generate_fused,
+    )
+    from kubegpu_tpu.models.lora import (
+        LoRAConfig,
+        lora_init,
+        lora_n_params,
+        make_lora_train_step,
+    )
+    from kubegpu_tpu.models.moe import MoEConfig, moe_greedy_generate, moe_init
+    from kubegpu_tpu.models.quant import quantize_llama
+    from kubegpu_tpu.models.t5 import t5_greedy_generate, t5_init
+    from kubegpu_tpu.models.t5 import T5Config
+
+    if on_tpu:
+        moe_cfg = moe_bench_config()
+        t5_cfg = t5_bench_config()
+        moe_b, moe_t, moe_steps = 8, 512, 64
+        t5_b, t5_t, t5_steps = 8, 512, 64
+        beam_b, beam_t, beam_steps, beams = 4, 512, 32, 4
+        spec_b, spec_t, spec_steps = 8, 1024, 128
+        lora_batch, iters = 4, 2
+    else:
+        moe_cfg = MoEConfig.tiny()
+        t5_cfg = T5Config.tiny()
+        moe_b, moe_t, moe_steps = 2, 8, 4
+        t5_b, t5_t, t5_steps = 2, 8, 4
+        beam_b, beam_t, beam_steps, beams = 2, 8, 3, 2
+        spec_b, spec_t, spec_steps = 2, 8, 6
+        lora_batch, iters = 2, 2
+    seq = cfg.max_seq_len
+
+    def prompt_of(b, t, vocab):
+        return jnp.asarray(
+            np.arange(b * t).reshape(b, t) % vocab, jnp.int32)
+
+    out = {}
+
+    # --- MoE serving: routed-expert decode, int8 KV cache ---
+    moe_params = moe_init(jax.random.PRNGKey(1), moe_cfg)
+    mp = prompt_of(moe_b, moe_t, moe_cfg.base.vocab_size)
+    moe_len = moe_t + moe_steps
+    moe_s = _time_calls(
+        lambda: moe_greedy_generate(moe_params, mp, moe_steps, moe_cfg,
+                                    max_len=moe_len, kv_int8=True),
+        lambda o: o, iters)
+    out["moe_serving"] = {
+        "params_m": round(sum(
+            x.size for x in jax.tree.leaves(moe_params)) / 1e6, 1),
+        "batch": moe_b, "prompt_len": moe_t, "steps": moe_steps,
+        "e2e_ms": round(moe_s * 1e3, 2),
+        "gen_tokens_per_s_e2e": round(moe_b * moe_steps / moe_s, 1),
+    }
+    del moe_params
+
+    # --- T5 serving: encode once + cached decode ---
+    t5_params = t5_init(jax.random.PRNGKey(2), t5_cfg)
+    tp = prompt_of(t5_b, t5_t, t5_cfg.vocab_size)
+    t5_s = _time_calls(
+        lambda: t5_greedy_generate(t5_params, tp, t5_steps, t5_cfg),
+        lambda o: o, iters)
+    out["t5_serving"] = {
+        "params_m": round(sum(
+            x.size for x in jax.tree.leaves(t5_params)) / 1e6, 1),
+        "batch": t5_b, "enc_len": t5_t, "steps": t5_steps,
+        "e2e_ms": round(t5_s * 1e3, 2),
+        "gen_tokens_per_s_e2e": round(t5_b * t5_steps / t5_s, 1),
+    }
+    del t5_params
+
+    # --- LoRA fine-tune step on the flagship params ---
+    lcfg = LoRAConfig(rank=8)
+    adapters = lora_init(jax.random.PRNGKey(3), params, lcfg)
+    opt = optax.adamw(1e-3)
+    lora_opt_state = opt.init(adapters)
+    lora_step = jax.jit(make_lora_train_step(cfg, lcfg, opt),
+                       donate_argnums=(0, 1))
+    toks = jnp.asarray(
+        np.arange(lora_batch * (seq + 1)).reshape(lora_batch, seq + 1)
+        % cfg.vocab_size, jnp.int32)
+    lora_s, _ = _time_chained(
+        lambda s: lora_step(s[0], s[1], params, toks),
+        (adapters, lora_opt_state), iters=max(iters * 3, 4))
+    out["lora"] = {
+        "rank": lcfg.rank,
+        "trainable_params_k": round(lora_n_params(adapters) / 1e3, 1),
+        "step_ms": round(lora_s * 1e3, 2),
+    }
+
+    # --- int8 + int8-KV llama serving variants: beam + speculative ---
+    qparams = quantize_llama(params)
+    bp = prompt_of(beam_b, beam_t, cfg.vocab_size)
+    beam_len = beam_t + beam_steps
+    beam_s = _time_calls(
+        lambda: beam_generate(qparams, bp, beam_steps, cfg, beams=beams,
+                              max_len=beam_len, kv_int8=True)[0],
+        lambda o: o, iters)
+    out["beam"] = {
+        "beams": beams, "batch": beam_b, "prompt_len": beam_t,
+        "steps": beam_steps, "e2e_ms": round(beam_s * 1e3, 2),
+    }
+
+    sp = prompt_of(spec_b, spec_t, cfg.vocab_size)
+    spec_len = spec_t + spec_steps
+    dl = max(1, cfg.n_layers // 4)
+    dview = draft_view(qparams, dl)
+    _, spec_stats = spec_generate_fused(
+        qparams, sp, spec_steps, cfg, dl, gamma=4, max_len=spec_len,
+        kv_int8=True, dparams=dview)
+    spec_s = _time_calls(
+        lambda: spec_generate_fused(qparams, sp, spec_steps, cfg, dl,
+                                    gamma=4, max_len=spec_len,
+                                    kv_int8=True, dparams=dview)[0],
+        lambda o: o, iters)
+    greedy_s = _time_calls(
+        lambda: greedy_generate(qparams, sp, spec_steps, cfg,
+                                max_len=spec_len, kv_int8=True),
+        lambda o: o, iters)
+    out["spec_decode"] = {
+        "draft_layers": dl, "gamma": 4, "batch": spec_b,
+        "prompt_len": spec_t, "steps": spec_steps,
+        "fused_e2e_ms": round(spec_s * 1e3, 2),
+        "greedy_e2e_ms": round(greedy_s * 1e3, 2),
+        # honest headline: > 1.0 only when draft acceptance pays for
+        # the draft+verify overhead (untrained bench weights accept ~0)
+        "speedup_vs_greedy": round(greedy_s / spec_s, 3),
+        "acceptance_rate": round(spec_stats["acceptance_rate"], 3),
+        "iterations": spec_stats["iterations"],
+    }
+    return out
 
 
 def run_model_bench(steps: int = 12) -> dict:
@@ -310,6 +485,12 @@ def run_model_bench(steps: int = 12) -> dict:
         "serving": (_serving_bench(cfg, params, on_tpu)
                     if os.environ.get("KUBETPU_BENCH_SERVING", "1") != "0"
                     else None),
+        # every remaining BASELINE.md hardware row, reproducibly
+        # (KUBETPU_BENCH_FAMILIES=0 skips)
+        "families": (_families_bench(cfg, params, on_tpu)
+                     if os.environ.get(
+                         "KUBETPU_BENCH_FAMILIES", "1") != "0"
+                     else None),
     }
     return out
 
@@ -409,14 +590,68 @@ def run_bench(n_gangs: int = 60, seed: int = 0) -> dict:
     }
 
 
+def run_serve_pod_bench(timeout_s: float = 600.0) -> dict:
+    """Serving as a SCHEDULABLE workload, measured end-to-end through
+    the cluster (VERDICT r2 weak #4: r2 only ever served the tiny
+    config from a pod): schedule the ``serve`` spec onto a SimCluster
+    whose crishim launches a REAL subprocess that inherits the real
+    TPU, let the annotation-driven config selection pick the flagship
+    (the node advertises a whole 16 GiB chip), and read the tokens/s
+    the node agent harvested into the cluster metrics registry.  The
+    number reported here came from a pod, not a library call."""
+    import jax
+
+    from kubegpu_tpu.cluster import SimCluster
+    from kubegpu_tpu.workloads.specs import ALL_CONFIGS
+
+    on_tpu = jax.devices()[0].platform.startswith(("tpu", "axon"))
+    # the pod must see the real TPU: no JAX_PLATFORMS=cpu override —
+    # but the subprocess whitelist needs PJRT tunnel vars passed through
+    extra = {k: v for k, v in os.environ.items()
+             if k.startswith(("JAX_", "TPU_", "PJRT_", "LIBTPU"))
+             and k not in ("JAX_PLATFORMS",)}
+    cl = SimCluster(["v4-8"], real_processes=True, extra_env=extra)
+    pods, _ = ALL_CONFIGS["serve"]()
+    for p in pods:
+        # flagship serving needs the full decode budget; drop the spec's
+        # CPU-sim-friendly step override so the bench config defaults
+        # (b32 x 1024 prompt x 128 steps, int8) apply on hardware
+        if on_tpu:
+            p.spec.containers[0].env.pop("SERVE_STEPS", None)
+        cl.submit(p)
+    codes = cl.run_to_completion(timeout_s=timeout_s)
+    snap = cl.metrics.snapshot()
+    return {
+        "exit_codes": codes,
+        "decode_tokens_per_s": snap["gauges"].get(
+            "workload_serve_decode_tokens_per_s"),
+        "e2e_tokens_per_s": snap["gauges"].get(
+            "workload_serve_e2e_tokens_per_s"),
+    }
+
+
 def run_full_bench(n_gangs: int = 60, seed: int = 0) -> dict:
     """The driver entry: scheduler bench + hardware model bench in one
     JSON document (details.model carries the MFU figure recorded in
-    BASELINE.md).  KUBETPU_BENCH_MODEL=0 skips the model half."""
+    BASELINE.md).  KUBETPU_BENCH_MODEL=0 skips the model half;
+    KUBETPU_BENCH_SERVE_POD=0 skips the scheduled-serving measurement
+    (it is skipped off-TPU automatically — the CPU path is covered by
+    the workload tests)."""
     out = run_bench(n_gangs=n_gangs, seed=seed)
     if os.environ.get("KUBETPU_BENCH_MODEL", "1") != "0":
         try:
             out["details"]["model"] = run_model_bench()
         except Exception as e:   # a broken chip must not hide metric #1
             out["details"]["model"] = {"error": str(e)}
+    if os.environ.get("KUBETPU_BENCH_SERVE_POD", "1") != "0":
+        # a broken backend must not hide metric #1 either — the TPU
+        # probe itself stays inside the guard (and JAX stays
+        # uninitialized for scheduler-only runs)
+        try:
+            import jax
+
+            if jax.devices()[0].platform.startswith(("tpu", "axon")):
+                out["details"]["serve_pod"] = run_serve_pod_bench()
+        except Exception as e:
+            out["details"]["serve_pod"] = {"error": str(e)}
     return out

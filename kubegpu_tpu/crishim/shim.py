@@ -58,6 +58,12 @@ class CriShim:
                 # fractional co-tenancy: the workload self-limits HBM use
                 env["KUBETPU_MILLITPU"] = str(sum(c.millichips
                                                  for c in alloc.chips))
+            # advertised capacity flows to the workload: serving picks
+            # its model scale from the allocation, not from guesswork
+            # (fractional grants scale the figure by their chip share)
+            env["KUBETPU_HBM_GIB"] = str(round(sum(
+                by_local[c.local_index].hbm_gib * c.millichips / 1000
+                for c in alloc.chips), 3))
             axes = pod_mesh_axes(pod)
             if axes:
                 # close the loop: the mesh the allocator optimized

@@ -444,6 +444,22 @@ def beam_generate(params: dict, prompt: jax.Array, n_steps: int,
                     kv_int8)(params, prompt)
 
 
+def generate(params: dict, prompt: jax.Array, n_steps: int,
+             cfg: LlamaConfig, max_len: int | None = None,
+             kv_int8: bool = False, ffn_factory=None,
+             ffn_cfg=None) -> jax.Array:
+    """Public greedy entry point with the feed-forward override hook:
+    ``ffn_factory(ffn_cfg)`` (both hashable — they key the compile
+    cache) builds an ``ffn(x, lp) -> x`` replacing the dense SwiGLU —
+    this is how other families (MoE's routed experts) ride the shared
+    rollout/compile-cache machinery without reaching into privates."""
+    t = prompt.shape[1]
+    max_len = _validate_rollout(cfg, t, n_steps, max_len)
+    return _generate_fn(cfg, t, n_steps, max_len, kv_int8,
+                        ffn_factory=ffn_factory,
+                        ffn_cfg=ffn_cfg)(params, prompt)
+
+
 def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
                     cfg: LlamaConfig,
                     max_len: int | None = None,
@@ -453,9 +469,8 @@ def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
     generated tokens [B, n_steps].  ``kv_int8`` stores the cache as
     int8 with per-token scales (half the cache HBM traffic — the
     dominant decode cost at wide batches)."""
-    t = prompt.shape[1]
-    max_len = _validate_rollout(cfg, t, n_steps, max_len)
-    return _generate_fn(cfg, t, n_steps, max_len, kv_int8)(params, prompt)
+    return generate(params, prompt, n_steps, cfg, max_len=max_len,
+                    kv_int8=kv_int8)
 
 
 # ---------------------------------------------------------------------------
@@ -600,7 +615,11 @@ def spec_generate(params: dict, prompt: jax.Array, n_steps: int,
         out.append(cur)
         pos += take + 1
         iterations += 1
-        proposed += g
+        # g-1, not g: `take` is capped at g-1 (the g-th draft token is
+        # only ever emitted as the "correction"), so g-1 is the number
+        # of slots that can actually be accepted — with g as the
+        # denominator a perfect draft reported at most (g-1)/g
+        proposed += g - 1
         accepted_total += take
     tokens = jnp.stack(out[:n_steps], axis=1)
     stats = {
@@ -608,3 +627,117 @@ def spec_generate(params: dict, prompt: jax.Array, n_steps: int,
         "acceptance_rate": (accepted_total / proposed) if proposed else 0.0,
     }
     return tokens, stats
+
+
+@functools.lru_cache(maxsize=32)
+def _spec_fused_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
+                   draft_layers: int, gamma: int, kv_int8: bool):
+    """One compiled executable for the ENTIRE speculative generation:
+    draft + verify + acceptance inside a ``lax.while_loop``.  The
+    host-loop :func:`spec_generate` pays a host round trip per
+    iteration for the data-dependent acceptance (``per_elem.min()``) —
+    under the async TPU tunnel that RTT dwarfs the decode step itself,
+    and even locally it serializes dispatch.  Here acceptance stays on
+    device: each iteration emits a fixed-width (γ+1) token slab at a
+    dynamic offset (accepted prefix + correction, tail slots carry the
+    correction as filler) and the next iteration's slab starts exactly
+    after the accepted prefix, overwriting the filler."""
+    import dataclasses
+
+    dcfg = dataclasses.replace(cfg, n_layers=draft_layers)
+    # verify chunks write cache rows up to pos+γ — up to γ-1 past the
+    # last emitted token — so the cache over-allocates by γ
+    clen = max_len + gamma
+    width = n_steps + gamma + 1   # out buffer: final slab may overhang
+
+    @jax.jit
+    def run(params, dparams, prompt):
+        b = prompt.shape[0]
+        logits, fcache = prefill(params, prompt, cfg, clen,
+                                 kv_int8=kv_int8)
+        _, dcache = prefill(dparams, prompt, dcfg, clen,
+                            kv_int8=kv_int8)
+        cur = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        out = jnp.zeros((b, width), prompt.dtype).at[:, 0].set(cur)
+        slots = jnp.arange(gamma + 1)
+
+        def cond(c):
+            return c[1] < n_steps
+
+        def body(c):
+            out, n_out, cur, pos, fcache, dcache, iters, acc, prop = c
+
+            def dstep(carry, i):
+                tok, dc = carry
+                dlogits, dc = decode_step(dparams, dc, tok, pos + i,
+                                          dcfg)
+                nxt = jnp.argmax(dlogits, axis=-1).astype(tok.dtype)
+                return (nxt, dc), nxt
+
+            (_, dcache), drafted = lax.scan(dstep, (cur, dcache),
+                                            jnp.arange(gamma))
+            drafted = drafted.swapaxes(0, 1)                 # [B, γ]
+            chunk = jnp.concatenate([cur[:, None], drafted], axis=1)
+            vlogits, fcache = _forward_with_cache(params, chunk, fcache,
+                                                  pos, cfg)
+            f = jnp.argmax(vlogits, axis=-1).astype(cur.dtype)
+            match = (drafted == f[:, :gamma]).astype(jnp.int32)
+            # lockstep accept: min over batch; cap γ-1 (the γ-th draft
+            # was never processed by the draft model — it re-emerges as
+            # the correction when all match) and the remaining budget
+            j = jnp.cumprod(match, axis=1).sum(axis=1).min()
+            take = jnp.minimum(jnp.minimum(j, gamma - 1),
+                               n_steps - n_out - 1)
+            corr = lax.dynamic_index_in_dim(f, take, axis=1,
+                                            keepdims=False)  # [B]
+            padded = jnp.concatenate([drafted, drafted[:, -1:]], axis=1)
+            emit = jnp.where(slots[None, :] < take, padded,
+                             corr[:, None])                  # [B, γ+1]
+            out = lax.dynamic_update_slice(out, emit, (0, n_out))
+            # acceptable slots this iteration, mirroring the host
+            # loop's g = min(gamma, remaining); proposed += g - 1 —
+            # keeps acceptance_rate identical between the two paths
+            # even when the budget truncates the final slab
+            prop_i = jnp.minimum(gamma, n_steps - n_out) - 1
+            return (out, n_out + take + 1, corr, pos + take + 1,
+                    fcache, dcache, iters + 1, acc + take,
+                    prop + prop_i)
+
+        init = (out, jnp.int32(1), cur, jnp.int32(t), fcache, dcache,
+                jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        out, _, _, _, _, _, iters, acc, prop = lax.while_loop(
+            cond, body, init)
+        return out[:, :n_steps], iters, acc, prop
+
+    return run
+
+
+def spec_generate_fused(params: dict, prompt: jax.Array, n_steps: int,
+                        cfg: LlamaConfig, draft_layers: int,
+                        gamma: int = 4, max_len: int | None = None,
+                        kv_int8: bool = False,
+                        dparams: dict | None = None
+                        ) -> tuple[jax.Array, dict]:
+    """:func:`spec_generate` as a single on-device executable (see
+    :func:`_spec_fused_fn`) — same contract, same emitted tokens (every
+    token is the full model's argmax), one dispatch for the whole
+    generation instead of a host-synced round trip per draft/verify
+    iteration.  Stats are fetched once at the end."""
+    t = prompt.shape[1]
+    max_len = _validate_rollout(cfg, t, n_steps, max_len)
+    if not 1 <= draft_layers <= cfg.n_layers:
+        raise ValueError(
+            f"draft_layers {draft_layers} not in [1, {cfg.n_layers}]")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if dparams is None:
+        dparams = draft_view(params, draft_layers)
+    toks, iters, acc, prop = _spec_fused_fn(
+        cfg, t, n_steps, max_len, draft_layers, gamma, kv_int8)(
+        params, dparams, prompt)
+    proposed = int(prop)
+    stats = {
+        "iterations": int(iters),
+        "acceptance_rate": (int(acc) / proposed) if proposed else 0.0,
+    }
+    return toks, stats

@@ -313,12 +313,11 @@ def moe_decode_step(params: dict, cache: dict, token, pos,
 def moe_greedy_generate(params: dict, prompt, n_steps: int,
                         cfg: MoEConfig, max_len: int | None = None,
                         kv_int8: bool = False):
-    """Greedy decode for the MoE family — decode's shared compile-cache
-    + rollout machinery with the routed-expert FFN swapped in via the
-    hashable (factory, cfg) pair; per-step routing runs over each
-    step's single token (capacity top_k at T=1)."""
-    t = prompt.shape[1]
-    max_len = decode._validate_rollout(cfg.base, t, n_steps, max_len)
-    return decode._generate_fn(cfg.base, t, n_steps, max_len, kv_int8,
-                               ffn_factory=_moe_decode_ffn,
-                               ffn_cfg=cfg)(params, prompt)
+    """Greedy decode for the MoE family — decode's public
+    :func:`kubegpu_tpu.models.decode.generate` with the routed-expert
+    FFN swapped in via the hashable (factory, cfg) pair; per-step
+    routing runs over each step's single token (capacity top_k at
+    T=1)."""
+    return decode.generate(params, prompt, n_steps, cfg.base,
+                           max_len=max_len, kv_int8=kv_int8,
+                           ffn_factory=_moe_decode_ffn, ffn_cfg=cfg)

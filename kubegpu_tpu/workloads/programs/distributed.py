@@ -20,11 +20,13 @@ class WorkerEnv:
     visible_chips: list[int]
     hostnames: list[str]
     millitpu: int | None
+    hbm_gib: float | None = None   # allocated HBM (crishim-injected)
 
 
 def read_env() -> WorkerEnv:
     chips = os.environ.get("TPU_VISIBLE_CHIPS", "")
     milli = os.environ.get("KUBETPU_MILLITPU")
+    hbm = os.environ.get("KUBETPU_HBM_GIB")
     return WorkerEnv(
         worker_id=int(os.environ.get("TPU_WORKER_ID", "0")),
         num_workers=int(os.environ.get("JAX_NUM_PROCESSES", "1")),
@@ -33,6 +35,7 @@ def read_env() -> WorkerEnv:
         hostnames=[h for h in os.environ.get(
             "TPU_WORKER_HOSTNAMES", "").split(",") if h],
         millitpu=int(milli) if milli else None,
+        hbm_gib=float(hbm) if hbm else None,
     )
 
 

@@ -1,13 +1,28 @@
 """Llama serving workload — decode as a SCHEDULABLE job, not just a
 library call: the pod runs prefill + greedy decode on its allocated
-chip(s) and prints a metric line the node agent harvests into the
+chip(s) and prints metric lines the node agent harvests into the
 cluster registry (like the allreduce bench does for north-star #2).
 
+Model scale is ANNOTATION-DRIVEN: when the allocation advertises a
+whole chip's HBM (KUBETPU_HBM_GIB >= 16, crishim-injected from the
+chip advertisement) and the backend is a real TPU, the pod serves the
+flagship bench config (618M, int8 weights + int8 KV cache — the
+>= 10k tok/s configuration from BASELINE.md) instead of the CPU-scale
+tiny model.  SERVE_CONFIG overrides: auto | tiny | bench.
+
 Env knobs:
-  SERVE_BATCH    sequences (default 4)
-  SERVE_PROMPT   prompt length (default 128)
-  SERVE_STEPS    decode steps (default 32)
-  SERVE_INT8     "1" quantizes weights AND KV cache (default 0)
+  SERVE_CONFIG   auto (default) | tiny | bench
+  SERVE_BATCH    sequences (default 4 tiny / 32 bench)
+  SERVE_PROMPT   prompt length (default 128 tiny / 1024 bench)
+  SERVE_STEPS    decode steps (default 32 tiny / 128 bench)
+  SERVE_INT8     "1" quantizes weights AND KV cache
+                 (default: 0 tiny, 1 bench)
+
+The decode throughput metric subtracts a separately-timed prefill of
+the same configuration (the advisor's r2 finding: dividing by an
+elapsed that includes prefill under-reports decode and diverges from
+benchmark.py's methodology); the prefill-inclusive figure is emitted
+separately as serve_e2e_tokens_per_s.
 """
 
 from __future__ import annotations
@@ -29,14 +44,29 @@ def main() -> int:
     from kubegpu_tpu.models import (
         LlamaConfig, greedy_generate, llama_init, quantize_llama,
     )
+    from kubegpu_tpu.models.decode import prefill
 
-    batch = int(os.environ.get("SERVE_BATCH", "4"))
-    prompt_t = int(os.environ.get("SERVE_PROMPT", "128"))
-    steps = int(os.environ.get("SERVE_STEPS", "32"))
-    int8 = os.environ.get("SERVE_INT8", "0") == "1"
+    mode = os.environ.get("SERVE_CONFIG", "auto")
+    on_tpu = jax.devices()[0].platform.startswith(("tpu", "axon"))
+    if mode == "auto":
+        mode = ("bench" if on_tpu and (env.hbm_gib or 0.0) >= 16.0
+                else "tiny")
 
-    cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, dtype="float32",
-                           max_seq_len=prompt_t + steps)
+    if mode == "bench":
+        from kubegpu_tpu.benchmark import llama_bench_config
+        batch = int(os.environ.get("SERVE_BATCH", "32"))
+        prompt_t = int(os.environ.get("SERVE_PROMPT", "1024"))
+        steps = int(os.environ.get("SERVE_STEPS", "128"))
+        int8 = os.environ.get("SERVE_INT8", "1") == "1"
+        cfg = llama_bench_config()
+    else:
+        batch = int(os.environ.get("SERVE_BATCH", "4"))
+        prompt_t = int(os.environ.get("SERVE_PROMPT", "128"))
+        steps = int(os.environ.get("SERVE_STEPS", "32"))
+        int8 = os.environ.get("SERVE_INT8", "0") == "1"
+        cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, dtype="float32",
+                               max_seq_len=prompt_t + steps)
+    max_len = prompt_t + steps
     params = llama_init(jax.random.PRNGKey(0), cfg)
     if int8:
         params = quantize_llama(params)
@@ -44,24 +74,56 @@ def main() -> int:
         np.arange(batch * prompt_t).reshape(batch, prompt_t)
         % cfg.vocab_size, jnp.int32)
 
-    out = greedy_generate(params, prompt, steps, cfg,
-                          max_len=prompt_t + steps, kv_int8=int8)
-    jax.block_until_ready(out)           # warm + compile
-    t0 = time.perf_counter()
-    out = greedy_generate(params, prompt, steps, cfg,
-                          max_len=prompt_t + steps, kv_int8=int8)
-    first = int(np.asarray(out)[0, 0])   # host fetch = real barrier
-    elapsed = time.perf_counter() - t0
+    def fetch(x):
+        # host fetch = the only reliable barrier under the async tunnel
+        return np.asarray(jax.device_get(jnp.ravel(x)[0]))
+
+    def timeit(fn, n=2):
+        out = fn()
+        fetch(out)          # warm + compile
+        t0 = time.perf_counter()
+        fetch(out)
+        rtt = time.perf_counter() - t0   # subtracted per burst: the
+        # end fetch's network round trip is not model time (matching
+        # benchmark.py's protocol — without this, e2e under-reports
+        # by ~RTT/2 per burst under the tunnel)
+        best = float("inf")
+        for _ in range(2):  # best-of-2: tunnel noise only ever adds
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn()
+            fetch(out)
+            best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
+        return best / n, out
+
+    pf = jax.jit(lambda p, tk: prefill(p, tk, cfg, max_len,
+                                       kv_int8=int8)[0])
+    prefill_s, _ = timeit(lambda: pf(params, prompt))
+    gen_s, out = timeit(
+        lambda: greedy_generate(params, prompt, steps, cfg,
+                                max_len=max_len, kv_int8=int8))
+    decode_s = max(gen_s - prefill_s, 1e-9)
+    first = int(np.asarray(out)[0, 0])
 
     ok = 0 <= first < cfg.vocab_size
     if env.worker_id == 0:
-        # the metric-line convention harvest_workload_metrics consumes
+        common = {
+            "unit": "tokens/s", "config": mode, "batch": batch,
+            "prompt": prompt_t, "steps": steps, "int8": int8,
+            "devices": jax.device_count(),
+        }
+        # the metric-line convention harvest_workload_metrics consumes;
+        # decode is isolated against the same-config prefill, matching
+        # benchmark.py's _serving_bench methodology
         print(json.dumps({
             "metric": "serve_decode_tokens_per_s",
-            "value": round(batch * steps / elapsed, 1),
-            "unit": "tokens/s",
-            "batch": batch, "prompt": prompt_t, "steps": steps,
-            "int8": int8, "devices": jax.device_count(),
+            "value": round(batch * (steps - 1) / decode_s, 1),
+            **common,
+        }))
+        print(json.dumps({
+            "metric": "serve_e2e_tokens_per_s",
+            "value": round(batch * steps / gen_s, 1),
+            **common,
         }))
     if not ok:
         print("FAIL: generated token out of range", file=sys.stderr)

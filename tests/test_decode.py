@@ -334,6 +334,65 @@ class TestSpeculative:
             spec_generate(params, prompt, 2, cfg, draft_layers=1,
                           gamma=0)
 
+    def test_fused_matches_host_loop(self, tiny):
+        """spec_generate_fused (one lax.while_loop executable) must emit
+        exactly the host loop's tokens — which are exactly greedy's —
+        for every (draft, gamma) shape, including n_steps that end
+        mid-slab."""
+        from kubegpu_tpu.models.decode import (
+            spec_generate,
+            spec_generate_fused,
+        )
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 6, dtype=jnp.int32).reshape(2, 6) * 5
+                  ) % cfg.vocab_size
+        for n in (1, 2, 9):
+            greedy = np.asarray(greedy_generate(params, prompt, n, cfg))
+            for dl, g in ((1, 4), (2, 2), (3, 3)):
+                host, hstats = spec_generate(params, prompt, n, cfg,
+                                             draft_layers=dl, gamma=g)
+                fused, fstats = spec_generate_fused(
+                    params, prompt, n, cfg, draft_layers=dl, gamma=g)
+                np.testing.assert_array_equal(
+                    np.asarray(fused), greedy,
+                    err_msg=f"n={n} draft_layers={dl} gamma={g}")
+                np.testing.assert_array_equal(np.asarray(host), greedy)
+                # n=1: the prefill emits the only token, the loop never
+                # runs — zero iterations is the correct report
+                assert fstats["iterations"] >= (1 if n > 1 else 0)
+                assert 0.0 <= fstats["acceptance_rate"] <= 1.0
+
+    def test_fused_kv_int8(self, tiny):
+        from kubegpu_tpu.models.decode import spec_generate_fused
+        cfg, params = tiny
+        prompt = (jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5)
+                  ) % cfg.vocab_size
+        toks, stats = spec_generate_fused(params, prompt, 6, cfg,
+                                          draft_layers=1, gamma=3,
+                                          kv_int8=True)
+        greedy = np.asarray(greedy_generate(params, prompt, 6, cfg,
+                                            kv_int8=True))
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
+
+    def test_perfect_draft_fused_acceptance(self, tiny):
+        """draft == model: the fused loop's acceptance must saturate at
+        1.0 now that the denominator counts acceptable slots (γ-1), not
+        proposals (the r2 advisor finding)."""
+        from kubegpu_tpu.models.decode import spec_generate_fused
+        cfg, params = tiny
+        prompt = (jnp.arange(5, dtype=jnp.int32)[None] * 3
+                  ) % cfg.vocab_size
+        # n=12 truncates the final slab (11 = 3+3+3+2): the proposed
+        # counter must mirror the host loop's min(gamma, remaining) - 1
+        # so a perfect draft still reads 1.0 (r3 review finding — the
+        # fixed-gamma denominator under-reported exactly these shapes)
+        toks, stats = spec_generate_fused(params, prompt, 12, cfg,
+                                          draft_layers=cfg.n_layers,
+                                          gamma=4)
+        greedy = np.asarray(greedy_generate(params, prompt, 12, cfg))
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
+        assert stats["acceptance_rate"] == 1.0
+
     def test_quantized_params_supported(self, tiny):
         """int8 weight trees (QTensor leaves) must slice into the draft
         view and decode — the quant.py drop-in contract extends to
