@@ -267,6 +267,7 @@ class HttpApiClient:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self._watchers: list[Callable[[WatchEvent], None]] = []
+        self._reset_hooks: list[Callable[[], None]] = []
         self._watch_lock = threading.Lock()
         self._watch_thread: threading.Thread | None = None
         self._watch_stop = threading.Event()
@@ -360,12 +361,20 @@ class HttpApiClient:
 
     # -- watch ----------------------------------------------------------
 
-    def watch(self, callback: Callable[[WatchEvent], None]) -> Callable[[], None]:
+    def watch(self, callback: Callable[[WatchEvent], None],
+              on_reset: Callable[[], None] | None = None
+              ) -> Callable[[], None]:
         """Subscribe via a shared background long-poll thread.  Events
         are re-materialized WatchEvents (objects deserialized), delivered
-        in order.  Unsubscribe stops the thread when no watchers remain."""
+        in order.  Unsubscribe stops the thread when no watchers remain.
+
+        ``on_reset`` fires when the server reports our position evicted
+        from the replay buffer (events were LOST): cache-maintaining
+        subscribers must relist, not merely continue."""
         with self._watch_lock:
             self._watchers.append(callback)
+            if on_reset is not None:
+                self._reset_hooks.append(on_reset)
             # (re)spawn when no thread runs OR the current one is
             # already winding down after a last-unsubscribe/stop: each
             # generation gets its OWN stop event, so a poller that is
@@ -382,6 +391,8 @@ class HttpApiClient:
             with self._watch_lock:
                 if callback in self._watchers:
                     self._watchers.remove(callback)
+                if on_reset is not None and on_reset in self._reset_hooks:
+                    self._reset_hooks.remove(on_reset)
                 if not self._watchers:
                     self._watch_stop.set()
         return unsubscribe
@@ -407,16 +418,33 @@ class HttpApiClient:
                 # would replay pre-subscription events to it, twice
                 break
             if out.get("reset"):
-                since = out["next"]   # lagged: skip ahead (caller relists)
+                since = out["next"]   # lagged: skip ahead
+                with self._watch_lock:
+                    hooks = list(self._reset_hooks)
+                for h in hooks:       # cache subscribers relist here
+                    try:
+                        h()
+                    except Exception as e:   # a failing relist (e.g.
+                        # transient HTTP error) must not kill the shared
+                        # poll thread — the next reset retries it
+                        log.error("watch_reset_hook", error=str(e))
                 continue
             since = out.get("next", since)
             for e in out.get("events", []):
-                ev = WatchEvent(kind=e["kind"], type=e["type"],
-                                obj=from_doc(e["kind"], e["object"]))
+                try:
+                    ev = WatchEvent(kind=e["kind"], type=e["type"],
+                                    obj=from_doc(e["kind"], e["object"]))
+                except (KeyError, ValueError, TypeError) as err:
+                    log.error("watch_event_decode", error=str(err))
+                    continue
                 with self._watch_lock:
                     watchers = list(self._watchers)
                 for w in watchers:
-                    w(ev)
+                    try:
+                        w(ev)
+                    except Exception as err:   # one bad subscriber must
+                        log.error("watch_callback",  # not starve the rest
+                                  error=str(err))
         with self._watch_lock:
             if self._watch_thread is threading.current_thread():
                 self._watch_thread = None

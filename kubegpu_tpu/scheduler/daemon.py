@@ -1,0 +1,136 @@
+"""Standalone scheduler daemon: ``python -m kubegpu_tpu.scheduler.daemon``.
+
+The reference's scheduler process (SURVEY.md §4.2): connect to the
+apiserver over HTTP, maintain a watch-fed local cache (the client-go
+reflector equivalent — ``kubemeta/cache.py``), and run the scheduling
+loop event-driven against that cache.  Every read (``run_once``'s
+pending scan, ``sync``'s full rebuild) is served locally; only
+binds/patches cross the wire.  Restart recovery is the annotation-truth
+path the scheduler already has: a fresh daemon's first ``sync()``
+rebuilds every commitment from pod annotations (SURVEY.md §4.4).
+
+(`scheduler/serve.py` is the kube-scheduler-facing extender WEBHOOK;
+this module is the full device scheduler as its own control loop.)
+
+    python -m kubegpu_tpu.scheduler.daemon \
+        --apiserver http://127.0.0.1:8901
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+from kubegpu_tpu.kubemeta.controlplane import Conflict, NotFound
+
+
+def build_scheduler(args):
+    """(api client, cache, scheduler, recovery) from flags — split from
+    main() so tests can drive the daemon in-process."""
+    from kubegpu_tpu.kubemeta.apiserver_http import HttpApiClient
+    from kubegpu_tpu.kubemeta.cache import WatchCachedApiClient
+    from kubegpu_tpu.obs import global_registry
+    from kubegpu_tpu.scheduler.extender import DeviceScheduler
+    from kubegpu_tpu.scheduler.health import FaultRecoveryController
+
+    api = HttpApiClient(args.apiserver)
+    cache = None
+    try:
+        cache = WatchCachedApiClient(api)
+        sched = DeviceScheduler(cache, metrics=global_registry,
+                                gang_grace_s=args.gang_grace)
+        recovery = FaultRecoveryController(cache, sched)
+    except BaseException:
+        # seeding can fail while the apiserver is still booting; the
+        # retry loop builds a fresh client, so close this one or every
+        # failed attempt leaks a long-poll watch thread that haunts the
+        # server forever once it's up
+        if cache is not None:
+            cache.close()
+        api.close()
+        raise
+    return api, cache, sched, recovery
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubetpu-scheduler",
+        description="device scheduler daemon over the HTTP apiserver "
+        "(watch-cached reads, event-driven loop)")
+    ap.add_argument("--apiserver", required=True,
+                    help="HTTP apiserver URL (kubemeta.apiserver_http)")
+    ap.add_argument("--tick", type=float, default=1.0,
+                    help="max seconds between passes when no events "
+                    "arrive (events wake the loop immediately)")
+    ap.add_argument("--gang-grace", type=float, default=30.0,
+                    help="incomplete-gang head-of-line grace (seconds)")
+    args = ap.parse_args(argv)
+
+    backoff = 0.2
+    while True:   # the apiserver may still be coming up (concurrent boot)
+        try:
+            api, cache, sched, recovery = build_scheduler(args)
+            break
+        except (OSError, ValueError, Conflict, NotFound) as e:
+            print(f"scheduler: cannot reach {args.apiserver}, retrying "
+                  f"in {backoff:.1f}s: {e}", file=sys.stderr)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 10.0)
+    print(f"scheduler: connected to {args.apiserver}", flush=True)
+
+    # Event-driven wakeup: pod/node churn triggers an immediate pass
+    # (the recovery controller watches through the same cache and marks
+    # itself dirty on node events); completions release chips exactly
+    # like SimCluster._on_event does in-process.
+    wake = threading.Event()
+
+    def on_event(ev) -> None:
+        if ev.kind == "Pod":
+            from kubegpu_tpu.kubemeta.objects import PodPhase
+            pod = ev.obj
+            if ev.type == "DELETED" or (
+                    ev.type == "MODIFIED" and pod.status.phase in (
+                        PodPhase.SUCCEEDED, PodPhase.FAILED)):
+                try:
+                    sched.return_pod_resources(pod.name,
+                                               pod.metadata.namespace)
+                except Exception as e:   # releasing must never kill us
+                    print(f"scheduler: release error for {pod.name}: "
+                          f"{e}", file=sys.stderr)
+        wake.set()
+
+    unsub = cache.watch(on_event)
+    backoff = args.tick
+    try:
+        while True:
+            wake.wait(timeout=args.tick)
+            wake.clear()
+            try:
+                recovery.run_once()
+                sched.run_once()
+                backoff = args.tick
+            except (OSError, ValueError, NotFound, Conflict) as e:
+                # transient control-plane failure: back off, retry —
+                # in-memory state re-syncs from annotation truth
+                print(f"scheduler: control-plane error, retrying in "
+                      f"{backoff:.1f}s: {e}", file=sys.stderr)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+                try:
+                    sched.sync()
+                except Exception:
+                    pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        unsub()
+        recovery.close()
+        cache.close()
+        api.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
