@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import array
 import ctypes
+import itertools
 import os
 import subprocess
 from pathlib import Path
@@ -88,6 +89,12 @@ def get_lib() -> ctypes.CDLL | None:
         i32, i32, i32, i32, i32, i32,
         ctypes.POINTER(ctypes.c_uint8), i32, i32, i32,
         i32, i32, i32, ctypes.POINTER(i32)]
+    lib.ktpu_rank_free_placements.restype = i32
+    lib.ktpu_rank_free_placements.argtypes = [
+        i32, i32, i32, i32, i32, i32,
+        ctypes.POINTER(ctypes.c_uint8), i32, i32, i32,
+        i32, i32, ctypes.POINTER(i32), ctypes.POINTER(i32),
+        ctypes.POINTER(ctypes.c_double)]
     _lib = lib
     return _lib
 
@@ -107,12 +114,24 @@ def _occupancy_mask(topo: TpuTopology, occupied: set[Coord]) -> ctypes.Array:
     return buf
 
 
+def occupancy_mask(topo: TpuTopology, occupied: set[Coord]):
+    """Prebuilt occupancy buffer for threading ONE O(chips) mask build
+    through a whole per-slice search (shape enumeration + frag ranking
+    + connected fallback all take it) — rebuilt per native call it was
+    ~30% of a small-gang decision on a 1024-chip cluster.  None when
+    the native library is unavailable."""
+    if get_lib() is None:
+        return None
+    return _occupancy_mask(topo, occupied)
+
+
 def _coords_array(coords) -> ctypes.Array:
-    """Coord iterable → int32 buffer via the array module (~3x cheaper
-    than the ctypes tuple-unpacking constructor at schedule call rates)."""
-    flat = array.array("i")
-    for c in coords:
-        flat.extend(c)
+    """Coord iterable → int32 buffer.  One array() construction over a
+    C-level chain instead of a Python-level extend per coord — the
+    per-coord loop was the top tottime line of 256-chip placements
+    (~37k extends per find_assignment for the ring-orientation
+    marshalling)."""
+    flat = array.array("i", itertools.chain.from_iterable(coords))
     return (ctypes.c_int32 * len(flat)).from_buffer(flat)
 
 
@@ -120,7 +139,7 @@ def _coords_array(coords) -> ctypes.Array:
 
 def find_free_placements_native(
     topo: TpuTopology, occupied: set[Coord], shape: Coord,
-    limit: int | None):
+    limit: int | None, mask=None):
     lib = get_lib()
     if lib is None:
         return None
@@ -137,7 +156,7 @@ def find_free_placements_native(
             dim - size + 1, 0)
     if max_out == 0:
         return []
-    occ = _occupancy_mask(topo, occupied)
+    occ = mask if mask is not None else _occupancy_mask(topo, occupied)
     origins = (ctypes.c_int32 * (max_out * 3))()
     coords = (ctypes.c_int32 * (max_out * vol * 3))()
     n = lib.ktpu_find_free_placements(
@@ -156,6 +175,47 @@ def find_free_placements_native(
         out.append(Placement(
             origin=(origins[i * 3], origins[i * 3 + 1], origins[i * 3 + 2]),
             shape=shape, coords=cs))
+    return out
+
+
+def rank_free_placements_native(
+    topo: TpuTopology, occupied: set[Coord], shape: Coord,
+    limit: int | None, k: int, mask=None):
+    """Fused enumerate + frag-rank: returns the top-``k`` free
+    placements of ``shape`` as ``[(frag, Placement), ...]`` sorted frag
+    descending (ties in enumeration order — byte-identical to the
+    Python rank-then-truncate), or None to fall back.  Keeps the
+    O(limit × shapes) placement objects out of Python entirely."""
+    lib = get_lib()
+    if lib is None or k <= 0:
+        return None
+    mx, my, mz = topo.spec.mesh_shape
+    wx, wy, wz = topo.spec.wrap
+    sx, sy, sz = shape
+    vol = sx * sy * sz
+    if vol == 0:
+        return []
+    occ = mask if mask is not None else _occupancy_mask(topo, occupied)
+    origins = (ctypes.c_int32 * (k * 3))()
+    coords = (ctypes.c_int32 * (k * vol * 3))()
+    frags = (ctypes.c_double * k)()
+    n = lib.ktpu_rank_free_placements(
+        mx, my, mz, int(wx), int(wy), int(wz), occ, sx, sy, sz,
+        0 if limit is None else limit, k, origins, coords, frags)
+    if n < 0:
+        return None
+    from kubegpu_tpu.topology.slices import Placement
+    out = []
+    for i in range(n):
+        base = i * vol * 3
+        cs = tuple(
+            (coords[base + j * 3], coords[base + j * 3 + 1],
+             coords[base + j * 3 + 2])
+            for j in range(vol))
+        out.append((frags[i], Placement(
+            origin=(origins[i * 3], origins[i * 3 + 1],
+                    origins[i * 3 + 2]),
+            shape=shape, coords=cs)))
     return out
 
 
@@ -186,8 +246,8 @@ def eval_order_native(
 
 
 def _flatten_options(options: list[list[list[Coord]]]) -> ctypes.Array:
-    return _coords_array(c for block in options
-                         for opt in block for c in opt)
+    return _coords_array(itertools.chain.from_iterable(
+        itertools.chain.from_iterable(options)))
 
 
 def orient_rings_native(options: list[list[list[Coord]]],
@@ -237,7 +297,7 @@ def align_units_native(options: list[list[list[Coord]]]
 
 def connected_order_native(
     topo: TpuTopology, blocked: set[Coord], total: int,
-    chips_per_pod: int, num_pods: int
+    chips_per_pod: int, num_pods: int, mask=None
 ) -> tuple[bool, list[Coord] | None] | None:
     """Native connected-region fallback search (gang.py
     ``_connected_candidate``): returns (True, order) with the chunked
@@ -249,7 +309,7 @@ def connected_order_native(
     mx, my, mz = topo.spec.mesh_shape
     wx, wy, wz = topo.spec.wrap
     hx, hy, hz = topo.spec.host_block
-    occ = _occupancy_mask(topo, blocked)
+    occ = mask if mask is not None else _occupancy_mask(topo, blocked)
     out = (ctypes.c_int32 * (total * 3))()
     rc = lib.ktpu_connected_order(
         mx, my, mz, int(wx), int(wy), int(wz), occ, hx, hy, hz,
@@ -265,12 +325,28 @@ def connected_order_native(
 
 def fragmentation_score_native(
     topo: TpuTopology, occupied: set[Coord], coords: tuple[Coord, ...]):
+    scorer = frag_scorer_native(topo, occupied)
+    if scorer is None:
+        return None
+    return scorer(coords)
+
+
+def frag_scorer_native(topo: TpuTopology, occupied: set[Coord],
+                       mask=None):
+    """Mask-reusing variant for scoring MANY placements against one
+    occupancy set: the O(chips) occupancy-mask build happens once, not
+    per placement (the per-shape ranking loop scores every free
+    placement — rebuilding the mask there dominated the 1024-chip
+    bench's decision time)."""
     lib = get_lib()
     if lib is None:
         return None
     mx, my, mz = topo.spec.mesh_shape
     wx, wy, wz = topo.spec.wrap
-    occ = _occupancy_mask(topo, occupied)
-    return lib.ktpu_fragmentation_score(
-        mx, my, mz, int(wx), int(wy), int(wz), occ,
-        _coords_array(list(coords)), len(coords))
+    occ = mask if mask is not None else _occupancy_mask(topo, occupied)
+
+    def score(coords) -> float:
+        return lib.ktpu_fragmentation_score(
+            mx, my, mz, int(wx), int(wy), int(wz), occ,
+            _coords_array(coords), len(coords))
+    return score

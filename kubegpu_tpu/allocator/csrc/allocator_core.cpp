@@ -70,6 +70,51 @@ struct SetKeyHash {
   }
 };
 
+// Shared fragmentation heuristic: fraction of the placement's boundary
+// (neighbor slots outside it) that is off-mesh or occupied.  `inplace`
+// is caller-provided scratch of m.ncells() bytes, containing the
+// placement's membership mask on entry; left CLEARED on exit (so a
+// ranking loop can reuse one buffer).  Both ktpu_fragmentation_score
+// and the fused ktpu_rank_free_placements call this — the rule must
+// exist exactly once.
+static double frag_score_masked(const MeshView& m, const uint8_t* occupied,
+                                const int32_t* coords, int32_t vol,
+                                std::vector<uint8_t>& inplace) {
+  for (int i = 0; i < vol; ++i) {
+    const int32_t* c = coords + i * 3;
+    inplace[m.cell(c[0], c[1], c[2])] = 1;
+  }
+  int64_t boundary = 0, blocked = 0;
+  for (int i = 0; i < vol; ++i) {
+    const int32_t* c = coords + i * 3;
+    for (int axis = 0; axis < 3; ++axis) {
+      const int dm = m.dim(axis);
+      for (int delta = -1; delta <= 1; delta += 2) {
+        int nc[3] = {c[0], c[1], c[2]};
+        nc[axis] += delta;
+        if (nc[axis] < 0 || nc[axis] >= dm) {
+          if (m.wrap(axis) && dm > 2) {
+            nc[axis] = ((nc[axis] % dm) + dm) % dm;
+          } else {
+            ++boundary;
+            ++blocked;  // mesh wall counts as packed-against
+            continue;
+          }
+        }
+        const int cell = m.cell(nc[0], nc[1], nc[2]);
+        if (inplace[cell]) continue;
+        ++boundary;
+        if (occupied[cell]) ++blocked;
+      }
+    }
+  }
+  for (int i = 0; i < vol; ++i) {
+    const int32_t* c = coords + i * 3;
+    inplace[m.cell(c[0], c[1], c[2])] = 0;
+  }
+  return boundary ? (double)blocked / (double)boundary : 1.0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -300,35 +345,107 @@ double ktpu_fragmentation_score(int32_t mx, int32_t my, int32_t mz,
                                 const int32_t* coords, int32_t vol) {
   MeshView m{mx, my, mz, wx != 0, wy != 0, wz != 0};
   std::vector<uint8_t> inplace(m.ncells(), 0);
-  for (int i = 0; i < vol; ++i) {
-    const int32_t* c = coords + i * 3;
-    inplace[m.cell(c[0], c[1], c[2])] = 1;
-  }
-  int64_t boundary = 0, blocked = 0;
-  for (int i = 0; i < vol; ++i) {
-    const int32_t* c = coords + i * 3;
-    for (int axis = 0; axis < 3; ++axis) {
-      const int dm = m.dim(axis);
-      for (int delta = -1; delta <= 1; delta += 2) {
-        int nc[3] = {c[0], c[1], c[2]};
-        nc[axis] += delta;
-        if (nc[axis] < 0 || nc[axis] >= dm) {
-          if (m.wrap(axis) && dm > 2) {
-            nc[axis] = ((nc[axis] % dm) + dm) % dm;
-          } else {
-            ++boundary;
-            ++blocked;  // mesh wall counts as packed-against
-            continue;
+  return frag_score_masked(m, occupied, coords, vol, inplace);
+}
+
+// Fused enumerate + fragmentation-rank (gang.py's per-shape candidate
+// ranking): enumerate free placements exactly like
+// ktpu_find_free_placements (same origin order, same dedup, stopping
+// after `limit` free placements), score each with the
+// ktpu_fragmentation_score heuristic inline, stable-sort by frag
+// descending (ties keep enumeration order, matching Python's stable
+// sort), and emit only the top `k`.  This keeps the ~limit×shapes
+// placement objects and their per-placement marshalling out of Python —
+// the scheduler only ever *scores* the top few per shape.
+//
+// out buffers sized for k placements.  Returns placements written,
+// -1 on buffer overflow (never happens with k-sized buffers), -2 when
+// the mesh exceeds the dedup key width (caller falls back to Python).
+int32_t ktpu_rank_free_placements(
+    int32_t mx, int32_t my, int32_t mz, int32_t wx, int32_t wy, int32_t wz,
+    const uint8_t* occupied, int32_t sx, int32_t sy, int32_t sz,
+    int32_t limit, int32_t k, int32_t* out_origins, int32_t* out_coords,
+    double* out_frag) {
+  MeshView m{mx, my, mz, wx != 0, wy != 0, wz != 0};
+  if (sx > mx || sy > my || sz > mz) return 0;
+  if (m.ncells() > 512) return -2;
+
+  auto origins = [&](int axis, int size) {
+    int dm = m.dim(axis);
+    int n = (m.wrap(axis) && dm > 2 && size < dm) ? dm : dm - size + 1;
+    return n;
+  };
+
+  std::unordered_set<SetKey, SetKeyHash> seen;
+  seen.reserve(256);
+  const int vol = sx * sy * sz;
+  struct Cand {
+    double frag;
+    int32_t idx;  // enumeration order (stable tie-break)
+    std::vector<int32_t> coords;
+    int32_t ox, oy, oz;
+  };
+  std::vector<Cand> cands;
+  std::vector<int32_t> coords(vol * 3);
+  std::vector<uint8_t> inplace(m.ncells(), 0);
+
+  const int nox = origins(0, sx), noy = origins(1, sy), noz = origins(2, sz);
+  int nfree = 0;
+  for (int ox = 0; ox < nox && (limit <= 0 || nfree < limit); ++ox) {
+    for (int oy = 0; oy < noy && (limit <= 0 || nfree < limit); ++oy) {
+      for (int oz = 0; oz < noz && (limit <= 0 || nfree < limit); ++oz) {
+        SetKey key{};
+        bool free_ok = true;
+        int kk = 0;
+        for (int dx = 0; dx < sx; ++dx) {
+          int x = ox + dx;
+          if (x >= mx) x -= mx;
+          for (int dy = 0; dy < sy; ++dy) {
+            int y = oy + dy;
+            if (y >= my) y -= my;
+            for (int dz = 0; dz < sz; ++dz) {
+              int z = oz + dz;
+              if (z >= mz) z -= mz;
+              int c = m.cell(x, y, z);
+              key.w[c >> 6] |= (1ull << (c & 63));
+              if (occupied[c]) free_ok = false;
+              coords[kk++] = x;
+              coords[kk++] = y;
+              coords[kk++] = z;
+            }
           }
         }
-        const int cell = m.cell(nc[0], nc[1], nc[2]);
-        if (inplace[cell]) continue;
-        ++boundary;
-        if (occupied[cell]) ++blocked;
+        if (!seen.insert(key).second) continue;
+        if (!free_ok) continue;
+        Cand cd;
+        cd.frag = frag_score_masked(m, occupied, coords.data(), vol,
+                                    inplace);
+        cd.idx = nfree;
+        cd.coords = coords;
+        cd.ox = ox;
+        cd.oy = oy;
+        cd.oz = oz;
+        cands.push_back(std::move(cd));
+        ++nfree;
       }
     }
   }
-  return boundary ? (double)blocked / (double)boundary : 1.0;
+  std::stable_sort(cands.begin(), cands.end(),
+                   [](const Cand& a, const Cand& b) {
+                     return a.frag > b.frag;
+                   });
+  int32_t nout = 0;
+  for (const Cand& cd : cands) {
+    if (nout >= k) break;
+    out_origins[nout * 3 + 0] = cd.ox;
+    out_origins[nout * 3 + 1] = cd.oy;
+    out_origins[nout * 3 + 2] = cd.oz;
+    std::memcpy(out_coords + (size_t)nout * vol * 3, cd.coords.data(),
+                sizeof(int32_t) * vol * 3);
+    out_frag[nout] = cd.frag;
+    ++nout;
+  }
+  return nout;
 }
 
 // Viterbi ring alignment (gang.py _align_units): choose an orientation per

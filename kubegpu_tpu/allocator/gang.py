@@ -26,6 +26,7 @@ from kubegpu_tpu.topology.slices import (
     Placement,
     find_free_placements,
     fragmentation_score,
+    fragmentation_scorer,
     subslice_shapes,
 )
 from kubegpu_tpu.tpuplugin.backend import MILLICHIPS_PER_CHIP, NodeAdvertisement
@@ -618,7 +619,11 @@ class GangAllocator:
             return self._find_fractional(slices, req)
         best: GangAssignment | None = None
         for st in slices:
-            cand = self._best_candidate_in_slice(st, req)
+            # threading the incumbent lets a later slice's whole search
+            # stop at the bound check before any ordering work when it
+            # provably cannot beat an earlier slice's candidate
+            cand = self._best_candidate_in_slice(
+                st, req, incumbent=best.score if best else None)
             if cand and (best is None or cand.score > best.score):
                 best = cand
         if best is None and req.allow_multislice and req.num_pods > 1 \
@@ -650,7 +655,9 @@ class GangAllocator:
     # -- whole-chip path -------------------------------------------------
 
     def _best_candidate_in_slice(self, st: SliceState,
-                                 req: GangRequest) -> GangAssignment | None:
+                                 req: GangRequest,
+                                 incumbent: float | None = None
+                                 ) -> GangAssignment | None:
         total = req.total_chips
         if total == 0 or total > len(st.available):
             return None
@@ -675,38 +682,79 @@ class GangAllocator:
         # resolve to an equal-scored placement).  This is what keeps the
         # empty-cluster small-gang case (many placements) off the p99.
         ranked: list[tuple[float, int, Placement]] = []
+        # ONE occupancy mask for the whole per-slice search: the shape
+        # scan, frag ranking, and connected fallback all reuse it (the
+        # per-call rebuild dominated 1024-chip decision times)
+        from kubegpu_tpu.allocator import _native
+        occ_mask = _native.occupancy_mask(st.topo, blocked)
+        fscore = fragmentation_scorer(st.topo, blocked, mask=occ_mask)
+        # Bound the ordering work, not just the candidate count: a
+        # 256-chip placement's ring search costs ~16x a 16-chip one's,
+        # and origin matters even less for big placements (fewer
+        # distinct origins, homogeneous torus) — so the per-shape
+        # scored-candidate budget shrinks as the ask grows, keeping
+        # decision cost ~flat across gang sizes (the 1024-chip p99
+        # was made of full-slice placements scoring 8 candidates each).
+        k_scored = max(2, min(self.max_scored_per_shape,
+                              (64 * self.max_scored_per_shape)
+                              // max(total, 1)))
         for si, shape in enumerate(subslice_shapes(
                 total, st.spec.mesh_shape)):
-            shape_ranked = [
-                (fragmentation_score(st.topo, blocked, pl), si, pl)
-                for pl in find_free_placements(
-                    st.topo, blocked, shape,
-                    limit=self.max_placements_per_shape)]
             # Only the top-frag few per shape get the expensive ordering
             # search: on a homogeneous torus, locality depends on the
             # shape far more than the origin, so the frag ranking is the
             # score ranking to within ties — every shape stays
             # represented, and the global bound below still applies.
+            # The enumerate+rank+truncate runs fused in C when the
+            # library is up (top-K only ever crosses back into Python).
+            native_ranked = _native.rank_free_placements_native(
+                st.topo, blocked, shape,
+                self.max_placements_per_shape,
+                k_scored, mask=occ_mask)
+            if native_ranked is not None:
+                ranked.extend((f, si, pl) for f, pl in native_ranked)
+                continue
+            shape_ranked = [
+                (fscore(pl), si, pl)
+                for pl in find_free_placements(
+                    st.topo, blocked, shape,
+                    limit=self.max_placements_per_shape,
+                    mask=occ_mask)]
             shape_ranked.sort(key=lambda t: -t[0])
-            ranked.extend(shape_ranked[:self.max_scored_per_shape])
+            ranked.extend(shape_ranked[:k_scored])
         # stable: frag desc, then the shape-compactness preference order
         ranked.sort(key=lambda t: (-t[0], t[1]))
         best: _Candidate | None = None
+        # a tie against the cross-slice incumbent also loses (strict >
+        # in find_assignment), so bounding out at <= is exact
+        floor = incumbent if incumbent is not None else float("-inf")
+        pruned_by_incumbent = False
         for frag, _, pl in ranked:
             bound = 10.0 * (self.locality_weight
                             + self.frag_weight * frag
                             + self.fill_weight * fill)
-            if best is not None and bound <= best.score:
+            if bound <= floor and best is None:
+                # every remaining candidate is bounded under the other
+                # slice's incumbent: without the incumbent this slice
+                # WOULD have scored a rectangular candidate (which then
+                # loses in find_assignment anyway), so the connected
+                # fallback below must not run — it isn't bounded by the
+                # rectangular bounds and could otherwise produce a
+                # non-rectangular win the pre-incumbent code never did
+                pruned_by_incumbent = True
+                break
+            if best is not None and bound <= max(best.score, floor):
                 break
             cand = self._score_placement(st, pl, req, axes, blocked, fill,
                                          frag=frag)
             if cand and (best is None or cand.score > best.score):
                 best = cand
-        if best is None:
+        if best is None and not pruned_by_incumbent:
             # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) fall back
             # to a connected free set — the reference's group allocator had
             # the same flexibility since groups weren't geometric.
-            cand = self._connected_candidate(st, req, blocked, axes)
+            cand = self._connected_candidate(st, req, blocked, axes,
+                                             mask=occ_mask)
             if cand is not None:
                 best = cand
         if best is None:
@@ -715,14 +763,15 @@ class GangAllocator:
 
     def _connected_candidate(self, st: SliceState, req: GangRequest,
                              blocked: set[Coord],
-                             axes: dict[str, int]) -> _Candidate | None:
+                             axes: dict[str, int],
+                             mask=None) -> _Candidate | None:
         """BFS-grow a connected set of free chips, chunked host-locally."""
         from kubegpu_tpu.allocator import _native
 
         total = req.total_chips
         c = req.chips_per_pod
         res = _native.connected_order_native(st.topo, blocked, total, c,
-                                             req.num_pods)
+                                             req.num_pods, mask=mask)
         if res is not None:
             found, order = res
             if not found:

@@ -236,12 +236,27 @@ class WatchCachedApiClient:
 
     def delete(self, kind: str, name: str,
                namespace: str = "default") -> None:
-        self.inner.delete(kind, name, namespace=namespace)
-        if kind in self._objs:
-            with self._lock:
-                key = f"{namespace}/{name}"
-                self._objs[kind].pop(key, None)
-                self._tombstones[kind].add(key)
+        if kind not in self._objs:
+            self.inner.delete(kind, name, namespace=namespace)
+            return
+        key = f"{namespace}/{name}"
+        # tombstone BEFORE the server call: a synchronous inner
+        # (FakeApiServer drains its DELETED event inside delete()) or a
+        # fast poll thread can deliver the tombstone-clearing event
+        # before this method resumes — adding afterwards would leak a
+        # tombstone that permanently blinds the cache to any future
+        # same-name object (r3 review finding)
+        with self._lock:
+            popped = self._objs[kind].pop(key, None)
+            self._tombstones[kind].add(key)
+        try:
+            self.inner.delete(kind, name, namespace=namespace)
+        except BaseException:
+            with self._lock:   # nothing was deleted: no event will come
+                self._tombstones[kind].discard(key)
+                if popped is not None and key not in self._objs[kind]:
+                    self._objs[kind][key] = popped
+            raise
 
     # -- watch ----------------------------------------------------------
 

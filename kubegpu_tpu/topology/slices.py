@@ -107,16 +107,20 @@ def find_free_placements(
     occupied: set[Coord],
     shape: Coord,
     limit: int | None = None,
+    mask=None,
 ) -> list[Placement]:
     """Free contiguous placements of ``shape`` given an occupancy set.
 
     This is the feasibility predicate behind the scheduler's ``/filter``
     verb (SURVEY.md §4.2).  ``limit`` caps the returned candidates so the
-    prioritize step scores a bounded set.
+    prioritize step scores a bounded set.  ``mask`` is an optional
+    prebuilt :func:`_native.occupancy_mask` for ``occupied`` (callers
+    scanning many shapes against one occupancy build it once).
     """
     from kubegpu_tpu.allocator import _native
 
-    native = _native.find_free_placements_native(topo, occupied, shape, limit)
+    native = _native.find_free_placements_native(topo, occupied, shape,
+                                                 limit, mask=mask)
     if native is not None:
         return native
     out: list[Placement] = []
@@ -172,6 +176,27 @@ def fragmentation_score(topo: TpuTopology, occupied: set[Coord],
         topo, occupied, placement.coords)
     if native is not None:
         return native
+    return _fragmentation_score_py(topo, occupied, placement)
+
+
+def fragmentation_scorer(topo: TpuTopology, occupied: set[Coord],
+                         mask=None):
+    """``placement -> score`` closure for scoring MANY placements
+    against ONE occupancy set: the native path builds its O(chips)
+    occupancy mask once instead of per call — the allocator's per-shape
+    ranking loop scores every free placement, and the repeated mask
+    build dominated 1024-chip decision times."""
+    from kubegpu_tpu.allocator import _native
+
+    native = _native.frag_scorer_native(topo, occupied, mask=mask)
+    if native is not None:
+        return lambda placement: native(placement.coords)
+    return lambda placement: _fragmentation_score_py(
+        topo, occupied, placement)
+
+
+def _fragmentation_score_py(topo: TpuTopology, occupied: set[Coord],
+                            placement: Placement) -> float:
     pset = set(placement.coords)
     boundary = 0
     blocked = 0

@@ -94,6 +94,44 @@ def test_find_free_placements_parity(topo_name):
 
 
 @pytest.mark.parametrize("topo_name", ["v5e-16", "v5e-64", "v5e-256",
+                                       "v4-16"])
+def test_rank_free_placements_parity(topo_name):
+    """The fused C enumerate+frag-rank must return exactly what the
+    Python pipeline (find placements → frag each → stable sort desc →
+    truncate) returns — origins, coords, and scores."""
+    from kubegpu_tpu.topology.slices import (
+        find_free_placements,
+        fragmentation_score,
+    )
+
+    topo = TpuTopology.build(TOPOLOGY_REGISTRY[topo_name])
+    rng = random.Random(hash(topo_name) & 0xFFF)
+    all_coords = [ch.coord for ch in topo.chips]
+    n = topo.spec.num_chips
+    for _ in range(15):
+        occupied = set(rng.sample(all_coords, rng.randrange(0, n)))
+        total = rng.choice([2, 4, 8, 16])
+        if total > n:
+            continue
+        for shape in subslice_shapes(total, topo.spec.mesh_shape):
+            for limit, k in ((None, 4), (6, 2), (64, 8)):
+                nat = _native.rank_free_placements_native(
+                    topo, occupied, shape, limit, k)
+                assert nat is not None
+                pls = find_free_placements(topo, occupied, shape,
+                                           limit=limit)
+                ranked = [(fragmentation_score(topo, occupied, pl), pl)
+                          for pl in pls]
+                ranked.sort(key=lambda t: -t[0])   # stable: ties keep
+                want = ranked[:k]                  # enumeration order
+                assert len(nat) == len(want)
+                for (nf, npl), (wf, wpl) in zip(nat, want):
+                    assert nf == pytest.approx(wf, abs=1e-12)
+                    assert npl.origin == wpl.origin
+                    assert npl.coords == wpl.coords
+
+
+@pytest.mark.parametrize("topo_name", ["v5e-16", "v5e-64", "v5e-256",
                                        "v4-16", "v5p-128"])
 def test_eval_order_parity(topo_name):
     topo = TpuTopology.build(TOPOLOGY_REGISTRY[topo_name])
