@@ -115,6 +115,71 @@ static double frag_score_masked(const MeshView& m, const uint8_t* occupied,
   return boundary ? (double)blocked / (double)boundary : 1.0;
 }
 
+// Shared free-placement enumeration: origin order (ox→oy→oz, wrapped
+// axes with dim>2 and size<dim contributing all origins), local
+// row-major coords (dx outer, dz inner), SetKey dedup BEFORE the
+// occupancy filter, stopping after `limit` free placements.  Both
+// ktpu_find_free_placements and the fused ktpu_rank_free_placements
+// enumerate through this — the order/dedup/limit rules must exist
+// exactly once (they define cross-path parity).  `emit(ox, oy, oz,
+// coords)` is called per free placement and returns false to abort.
+// Returns 0, or -2 when the mesh exceeds the dedup key width, or -3
+// when emit aborted.
+template <typename F>
+static int32_t for_each_free_placement(const MeshView& m,
+                                       const uint8_t* occupied, int32_t sx,
+                                       int32_t sy, int32_t sz,
+                                       int32_t limit, F&& emit) {
+  if (sx > m.mx || sy > m.my || sz > m.mz) return 0;
+  if (m.ncells() > 512) return -2;  // key width exceeded
+
+  auto origins = [&](int axis, int size) {
+    int dm = m.dim(axis);
+    int n = (m.wrap(axis) && dm > 2 && size < dm) ? dm : dm - size + 1;
+    return n;
+  };
+
+  std::unordered_set<SetKey, SetKeyHash> seen;
+  seen.reserve(256);
+  const int vol = sx * sy * sz;
+  std::vector<int32_t> coords(vol * 3);
+  int nfree = 0;
+  const int nox = origins(0, sx), noy = origins(1, sy), noz = origins(2, sz);
+  for (int ox = 0; ox < nox; ++ox) {
+    for (int oy = 0; oy < noy; ++oy) {
+      for (int oz = 0; oz < noz; ++oz) {
+        SetKey key{};
+        bool free_ok = true;
+        int k = 0;
+        for (int dx = 0; dx < sx; ++dx) {
+          int x = ox + dx;
+          if (x >= m.mx) x -= m.mx;
+          for (int dy = 0; dy < sy; ++dy) {
+            int y = oy + dy;
+            if (y >= m.my) y -= m.my;
+            for (int dz = 0; dz < sz; ++dz) {
+              int z = oz + dz;
+              if (z >= m.mz) z -= m.mz;
+              int c = m.cell(x, y, z);
+              key.w[c >> 6] |= (1ull << (c & 63));
+              if (occupied[c]) free_ok = false;
+              coords[k++] = x;
+              coords[k++] = y;
+              coords[k++] = z;
+            }
+          }
+        }
+        if (!seen.insert(key).second) continue;
+        if (!free_ok) continue;
+        if (!emit(ox, oy, oz, coords.data())) return -3;
+        ++nfree;
+        if (limit > 0 && nfree >= limit) return 0;
+      }
+    }
+  }
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -137,63 +202,22 @@ int32_t ktpu_find_free_placements(
     int32_t limit, int32_t max_out, int32_t* out_origins,
     int32_t* out_coords) {
   MeshView m{mx, my, mz, wx != 0, wy != 0, wz != 0};
-  if (sx > mx || sy > my || sz > mz) return 0;
-  if (m.ncells() > 512) return -2;  // key width exceeded; caller falls back
-
-  auto origins = [&](int axis, int size) {
-    int dm = m.dim(axis);
-    // wrapped placements are legal on a torus axis (dim>2) when the
-    // placement does not already span the full axis
-    int n = (m.wrap(axis) && dm > 2 && size < dm) ? dm : dm - size + 1;
-    return n;
-  };
-
-  std::unordered_set<SetKey, SetKeyHash> seen;
-  seen.reserve(256);
   const int vol = sx * sy * sz;
   int32_t nout = 0;
-  std::vector<int32_t> coords(vol * 3);
-
-  const int nox = origins(0, sx), noy = origins(1, sy), noz = origins(2, sz);
-  for (int ox = 0; ox < nox; ++ox) {
-    for (int oy = 0; oy < noy; ++oy) {
-      for (int oz = 0; oz < noz; ++oz) {
-        SetKey key{};
-        bool free_ok = true;
-        int k = 0;
-        for (int dx = 0; dx < sx; ++dx) {
-          int x = ox + dx;
-          if (x >= mx) x -= mx;
-          for (int dy = 0; dy < sy; ++dy) {
-            int y = oy + dy;
-            if (y >= my) y -= my;
-            for (int dz = 0; dz < sz; ++dz) {
-              int z = oz + dz;
-              if (z >= mz) z -= mz;
-              int c = m.cell(x, y, z);
-              key.w[c >> 6] |= (1ull << (c & 63));
-              if (occupied[c]) free_ok = false;
-              coords[k++] = x;
-              coords[k++] = y;
-              coords[k++] = z;
-            }
-          }
-        }
-        // dedup applies to ALL enumerated placements (python dedups in
-        // enumerate_placements before the occupancy filter)
-        if (!seen.insert(key).second) continue;
-        if (!free_ok) continue;
-        if (nout >= max_out) return -1;
+  int32_t rc = for_each_free_placement(
+      m, occupied, sx, sy, sz, limit,
+      [&](int ox, int oy, int oz, const int32_t* coords) {
+        if (nout >= max_out) return false;  // caller buffer overflow
         out_origins[nout * 3 + 0] = ox;
         out_origins[nout * 3 + 1] = oy;
         out_origins[nout * 3 + 2] = oz;
-        std::memcpy(out_coords + (size_t)nout * vol * 3, coords.data(),
+        std::memcpy(out_coords + (size_t)nout * vol * 3, coords,
                     sizeof(int32_t) * vol * 3);
         ++nout;
-        if (limit > 0 && nout >= limit) return nout;
-      }
-    }
-  }
+        return true;
+      });
+  if (rc == -3) return -1;  // emit aborted = buffer overflow
+  if (rc < 0) return rc;
   return nout;
 }
 
@@ -367,69 +391,27 @@ int32_t ktpu_rank_free_placements(
     int32_t limit, int32_t k, int32_t* out_origins, int32_t* out_coords,
     double* out_frag) {
   MeshView m{mx, my, mz, wx != 0, wy != 0, wz != 0};
-  if (sx > mx || sy > my || sz > mz) return 0;
-  if (m.ncells() > 512) return -2;
-
-  auto origins = [&](int axis, int size) {
-    int dm = m.dim(axis);
-    int n = (m.wrap(axis) && dm > 2 && size < dm) ? dm : dm - size + 1;
-    return n;
-  };
-
-  std::unordered_set<SetKey, SetKeyHash> seen;
-  seen.reserve(256);
   const int vol = sx * sy * sz;
   struct Cand {
     double frag;
-    int32_t idx;  // enumeration order (stable tie-break)
     std::vector<int32_t> coords;
     int32_t ox, oy, oz;
   };
   std::vector<Cand> cands;
-  std::vector<int32_t> coords(vol * 3);
   std::vector<uint8_t> inplace(m.ncells(), 0);
-
-  const int nox = origins(0, sx), noy = origins(1, sy), noz = origins(2, sz);
-  int nfree = 0;
-  for (int ox = 0; ox < nox && (limit <= 0 || nfree < limit); ++ox) {
-    for (int oy = 0; oy < noy && (limit <= 0 || nfree < limit); ++oy) {
-      for (int oz = 0; oz < noz && (limit <= 0 || nfree < limit); ++oz) {
-        SetKey key{};
-        bool free_ok = true;
-        int kk = 0;
-        for (int dx = 0; dx < sx; ++dx) {
-          int x = ox + dx;
-          if (x >= mx) x -= mx;
-          for (int dy = 0; dy < sy; ++dy) {
-            int y = oy + dy;
-            if (y >= my) y -= my;
-            for (int dz = 0; dz < sz; ++dz) {
-              int z = oz + dz;
-              if (z >= mz) z -= mz;
-              int c = m.cell(x, y, z);
-              key.w[c >> 6] |= (1ull << (c & 63));
-              if (occupied[c]) free_ok = false;
-              coords[kk++] = x;
-              coords[kk++] = y;
-              coords[kk++] = z;
-            }
-          }
-        }
-        if (!seen.insert(key).second) continue;
-        if (!free_ok) continue;
+  int32_t rc = for_each_free_placement(
+      m, occupied, sx, sy, sz, limit,
+      [&](int ox, int oy, int oz, const int32_t* coords) {
         Cand cd;
-        cd.frag = frag_score_masked(m, occupied, coords.data(), vol,
-                                    inplace);
-        cd.idx = nfree;
-        cd.coords = coords;
+        cd.frag = frag_score_masked(m, occupied, coords, vol, inplace);
+        cd.coords.assign(coords, coords + vol * 3);
         cd.ox = ox;
         cd.oy = oy;
         cd.oz = oz;
         cands.push_back(std::move(cd));
-        ++nfree;
-      }
-    }
-  }
+        return true;
+      });
+  if (rc < 0) return rc;
   std::stable_sort(cands.begin(), cands.end(),
                    [](const Cand& a, const Cand& b) {
                      return a.frag > b.frag;

@@ -725,34 +725,31 @@ class GangAllocator:
         # stable: frag desc, then the shape-compactness preference order
         ranked.sort(key=lambda t: (-t[0], t[1]))
         best: _Candidate | None = None
-        # a tie against the cross-slice incumbent also loses (strict >
-        # in find_assignment), so bounding out at <= is exact
+        # Bounding out at <= is exact for the RECTANGULAR search: a tie
+        # against the cross-slice incumbent also loses (strict > in
+        # find_assignment).
         floor = incumbent if incumbent is not None else float("-inf")
-        pruned_by_incumbent = False
         for frag, _, pl in ranked:
             bound = 10.0 * (self.locality_weight
                             + self.frag_weight * frag
                             + self.fill_weight * fill)
-            if bound <= floor and best is None:
-                # every remaining candidate is bounded under the other
-                # slice's incumbent: without the incumbent this slice
-                # WOULD have scored a rectangular candidate (which then
-                # loses in find_assignment anyway), so the connected
-                # fallback below must not run — it isn't bounded by the
-                # rectangular bounds and could otherwise produce a
-                # non-rectangular win the pre-incumbent code never did
-                pruned_by_incumbent = True
-                break
-            if best is not None and bound <= max(best.score, floor):
+            if bound <= floor or (best is not None
+                                  and bound <= best.score):
                 break
             cand = self._score_placement(st, pl, req, axes, blocked, fill,
                                          frag=frag)
             if cand and (best is None or cand.score > best.score):
                 best = cand
-        if best is None and not pruned_by_incumbent:
+        if best is None:
             # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) fall back
             # to a connected free set — the reference's group allocator had
-            # the same flexibility since groups weren't geometric.
+            # the same flexibility since groups weren't geometric.  Runs
+            # whenever NO rectangular candidate was produced, including
+            # incumbent-pruned searches: the connected score is not
+            # bounded by the rectangular frag bounds, so dropping it
+            # there could silently discard a strictly better placement
+            # (r3 review finding); find_assignment compares the result
+            # against the incumbent either way.
             cand = self._connected_candidate(st, req, blocked, axes,
                                              mask=occ_mask)
             if cand is not None:

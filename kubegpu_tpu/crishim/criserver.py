@@ -1,17 +1,27 @@
 """CRI-shaped wire boundary for the shim — reference: SURVEY.md §4.3.
 
 The reference's crishim was a real gRPC server implementing the kubelet
-CRI (``RuntimeService``) on a unix socket; kubelet never called the shim
-in-process.  This module restores that transport seam in the simulated
-stack: a :class:`CriServer` listens on a unix socket speaking
-length-prefixed JSON frames whose method names and message shapes mirror
-the CRI RuntimeService (``Version``, ``CreateContainer``,
-``StartContainer``, ``ContainerStatus``, ``StopContainer``,
-``RemoveContainer``, ``ListContainers``), and a :class:`RemoteCriShim`
-client gives :class:`~kubegpu_tpu.crishim.agent.NodeAgent` the same
+CRI (``RuntimeService``/``ImageService``) on a unix socket; kubelet
+never called the shim in-process.  This module restores that transport
+seam in the simulated stack: a :class:`CriServer` listens on a unix
+socket speaking length-prefixed JSON frames whose method names and
+message shapes mirror the CRI RuntimeService (``Version``,
+``CreateContainer``, ``StartContainer``, ``ContainerStatus``,
+``StopContainer``, ``RemoveContainer``, ``ListContainers``) AND the
+ImageService half (``PullImage``, ``ImageStatus``, ``ListImages``,
+``RemoveImage``, ``ImageFsInfo``) on the same socket — the deployment
+shape kubelet expects (one endpoint serving both services).  The image
+store is per-node and passthrough-shaped: a pull registers the ref
+under a deterministic digest (workload "images" here are the runtime
+environment, not layer tarballs), and ``CreateContainer`` enforces
+kubelet's pull-serialize contract — creating with an unpulled image is
+an error, exactly as a real runtime reports ``image not found``.  A
+:class:`RemoteCriShim` client gives
+:class:`~kubegpu_tpu.crishim.agent.NodeAgent` the same
 ``create_container(pod) -> handle`` seam it has with the in-process
-:class:`~kubegpu_tpu.crishim.shim.CriShim` — except every call traverses
-the socket, exactly as kubelet→crishim did.
+:class:`~kubegpu_tpu.crishim.shim.CriShim` — except every call
+traverses the socket (pull → create → start), exactly as
+kubelet→crishim did.
 
 Wire format: 4-byte big-endian length prefix, then a UTF-8 JSON object
 ``{"method": str, "request": {...}}``; response frames are
@@ -29,6 +39,7 @@ the NodeAgent enforces in ``reconcile``).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import socket
@@ -116,6 +127,9 @@ class CriServer:
             socket_path = os.path.join(self._tmpdir, "cri.sock")
         self.socket_path = socket_path
         self._handles: dict[str, ContainerHandle] = {}
+        # ImageService store: ref → image record (per-node, like a
+        # node's containerd image store)
+        self._images: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
@@ -213,6 +227,15 @@ class CriServer:
                 raise CriError(
                     f"pod {pod_name} has no container {container_name!r}")
             index = names.index(container_name)
+        # kubelet's pull-serialize contract: the image must be present
+        # before create (a real runtime fails with "image not found")
+        ref = ((config.get("image") or {}).get("image")
+               or pod.spec.containers[index].image)
+        with self._lock:
+            present = ref in self._images
+        if not present:
+            raise CriError(
+                f"image {ref!r} not present on node (PullImage first)")
         handle = self.shim.create_container(pod, container_index=index)
         container_id = uuid.uuid4().hex[:16]
         with self._lock:
@@ -274,6 +297,74 @@ class CriServer:
                 "state": CONTAINER_RUNNING if running else CONTAINER_EXITED,
             })
         return {"containers": out}
+
+    # -- ImageService verbs (same socket, kubelet's expected shape) ------
+
+    @staticmethod
+    def _image_ref(request: dict) -> str:
+        ref = ((request.get("image") or {}).get("image") or "").strip()
+        if not ref:
+            raise CriError("empty image reference")
+        return ref
+
+    def _verb_PullImage(self, request: dict) -> dict:
+        """Passthrough pull: register the ref under a deterministic
+        digest.  Idempotent (a re-pull refreshes nothing — refs are
+        content-stable here, as with tag-pinned digests)."""
+        ref = self._image_ref(request)
+        digest = "sha256:" + hashlib.sha256(ref.encode()).hexdigest()
+        # strip only a TAG (colon after the last '/'): a plain split(':')
+        # would truncate registry-port refs like registry:5000/app:v1
+        repo = (ref.rsplit(":", 1)[0]
+                if ":" in ref.rsplit("/", 1)[-1] else ref)
+        with self._lock:
+            self._images.setdefault(ref, {
+                "id": digest,
+                "repo_tags": [ref],
+                "repo_digests": [f"{repo}@{digest}"],
+                # deterministic pseudo-size so ImageFsInfo sums move
+                "size": int.from_bytes(
+                    digest.encode()[7:11], "big") % (1 << 28),
+                "pulled_at": time.time(),
+            })
+        log.info("pull_image", image=ref, node=self.node_name)
+        return {"image_ref": digest}
+
+    def _verb_ImageStatus(self, request: dict) -> dict:
+        ref = self._image_ref(request)
+        with self._lock:
+            img = self._images.get(ref)
+        if img is None:
+            return {"image": None}   # CRI: absent image → null, not error
+        return {"image": {k: img[k] for k in
+                          ("id", "repo_tags", "repo_digests", "size")}}
+
+    def _verb_ListImages(self, request: dict) -> dict:
+        want = ((request.get("filter") or {}).get("image") or {}).get(
+            "image")
+        with self._lock:
+            items = list(self._images.items())
+        return {"images": [
+            {k: img[k] for k in ("id", "repo_tags", "repo_digests",
+                                 "size")}
+            for ref, img in items if not want or ref == want]}
+
+    def _verb_RemoveImage(self, request: dict) -> dict:
+        ref = self._image_ref(request)
+        with self._lock:
+            self._images.pop(ref, None)   # CRI: remove is idempotent
+        return {}
+
+    def _verb_ImageFsInfo(self, request: dict) -> dict:
+        with self._lock:
+            used = sum(img["size"] for img in self._images.values())
+            count = len(self._images)
+        return {"image_filesystems": [{
+            "timestamp": int(time.time() * 1e9),
+            "fs_id": {"mountpoint": tempfile.gettempdir()},
+            "used_bytes": {"value": used},
+            "inodes_used": {"value": count},
+        }]}
 
     def _handle_of(self, request: dict) -> ContainerHandle:
         cid = str(request.get("container_id") or "")
@@ -383,9 +474,13 @@ class RemoteCriShim:
     def create_container(self, pod: Pod,
                          container_index: int = 0) -> RemoteContainerHandle:
         spec = pod.spec.containers[container_index]
+        # kubelet's sequence: EnsureImageExists (PullImage) → create →
+        # start — the create verb refuses unpulled images
+        self.client.call("PullImage", {"image": {"image": spec.image}})
         out = self.client.call("CreateContainer", {
             "config": {
                 "metadata": {"name": spec.name},
+                "image": {"image": spec.image},
                 "labels": {
                     POD_NAME_LABEL: pod.name,
                     POD_NAMESPACE_LABEL: pod.metadata.namespace,

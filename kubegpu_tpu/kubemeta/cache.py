@@ -252,10 +252,17 @@ class WatchCachedApiClient:
         try:
             self.inner.delete(kind, name, namespace=namespace)
         except BaseException:
-            with self._lock:   # nothing was deleted: no event will come
-                self._tombstones[kind].discard(key)
-                if popped is not None and key not in self._objs[kind]:
-                    self._objs[kind][key] = popped
+            with self._lock:
+                if key in self._tombstones[kind]:
+                    # our delete did not happen AND no DELETED event has
+                    # landed: roll back.  (If a concurrent deleter's
+                    # DELETED event already consumed the tombstone, the
+                    # object IS gone server-side — restoring `popped`
+                    # would plant a permanent ghost, since its only
+                    # DELETED event was just spent.)
+                    self._tombstones[kind].discard(key)
+                    if popped is not None and key not in self._objs[kind]:
+                        self._objs[kind][key] = popped
             raise
 
     # -- watch ----------------------------------------------------------

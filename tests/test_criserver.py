@@ -88,8 +88,12 @@ class TestProtocol:
         api, backend, runtime, server, client = served
         api.create("Pod", tpu_pod("p", chips=0, command=["noop"]))
         pod = api.get("Pod", "p")
+        # kubelet's sequence: the image must be pulled before create
+        image = pod.spec.containers[0].image
+        client.call("PullImage", {"image": {"image": image}})
         out = client.call("CreateContainer", {"config": {
             "metadata": {"name": "main"},
+            "image": {"image": image},
             "labels": {POD_NAME_LABEL: "p",
                        POD_NAMESPACE_LABEL: "default",
                        POD_UID_LABEL: pod.metadata.uid}}})
@@ -103,6 +107,61 @@ class TestProtocol:
         assert st["status"]["exit_code"] == 0
         client.call("RemoveContainer", {"container_id": cid})
         assert client.call("ListContainers")["containers"] == []
+
+
+class TestImageService:
+    """The ImageService half of the CRI contract (SURVEY.md §2 L2),
+    served on the SAME socket as the RuntimeService — kubelet expects
+    one endpoint for both."""
+
+    def test_pull_status_list_remove(self, served):
+        api, backend, runtime, server, client = served
+        ref = "kubetpu/runtime:latest"
+        assert client.call("ImageStatus",
+                           {"image": {"image": ref}})["image"] is None
+        out = client.call("PullImage", {"image": {"image": ref}})
+        assert out["image_ref"].startswith("sha256:")
+        st = client.call("ImageStatus", {"image": {"image": ref}})["image"]
+        assert st["id"] == out["image_ref"]
+        assert st["repo_tags"] == [ref]
+        assert st["size"] > 0
+        # idempotent re-pull keeps the same digest
+        assert client.call("PullImage",
+                           {"image": {"image": ref}})["image_ref"] \
+            == out["image_ref"]
+        client.call("PullImage", {"image": {"image": "other:v1"}})
+        allimgs = client.call("ListImages")["images"]
+        assert len(allimgs) == 2
+        only = client.call("ListImages", {"filter": {
+            "image": {"image": ref}}})["images"]
+        assert [i["id"] for i in only] == [out["image_ref"]]
+        fs = client.call("ImageFsInfo")["image_filesystems"][0]
+        assert fs["inodes_used"]["value"] == 2
+        assert fs["used_bytes"]["value"] > 0
+        client.call("RemoveImage", {"image": {"image": ref}})
+        assert client.call("ImageStatus",
+                           {"image": {"image": ref}})["image"] is None
+        client.call("RemoveImage", {"image": {"image": ref}})  # idempotent
+
+    def test_create_requires_pulled_image(self, served):
+        """kubelet's pull-serialize contract: CreateContainer with an
+        unpulled image fails like a real runtime's 'image not found',
+        and succeeds after PullImage."""
+        api, backend, runtime, server, client = served
+        api.create("Pod", tpu_pod("p", chips=0, command=["noop"]))
+        pod = api.get("Pod", "p")
+        req = {"config": {
+            "metadata": {"name": "main"},
+            "labels": {POD_NAME_LABEL: "p",
+                       POD_NAMESPACE_LABEL: "default",
+                       POD_UID_LABEL: pod.metadata.uid}}}
+        with pytest.raises(CriError, match="not present"):
+            client.call("CreateContainer", req)
+        client.call("PullImage", {"image": {
+            "image": pod.spec.containers[0].image}})
+        out = client.call("CreateContainer", req)
+        client.call("RemoveContainer",
+                    {"container_id": out["container_id"]})
 
 
 class TestRemoteShim:
