@@ -740,16 +740,18 @@ class GangAllocator:
                                          frag=frag)
             if cand and (best is None or cand.score > best.score):
                 best = cand
-        if best is None:
+        if best is None and (not ranked or incumbent is None):
             # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) fall back
             # to a connected free set — the reference's group allocator had
-            # the same flexibility since groups weren't geometric.  Runs
-            # whenever NO rectangular candidate was produced, including
-            # incumbent-pruned searches: the connected score is not
-            # bounded by the rectangular frag bounds, so dropping it
-            # there could silently discard a strictly better placement
-            # (r3 review finding); find_assignment compares the result
-            # against the incumbent either way.
+            # the same flexibility since groups weren't geometric.
+            # Gating (r3 review, twice-revised): with NO incumbent this
+            # is exactly the pre-r3 rule (fallback when nothing
+            # rectangular scored); with an incumbent the fallback runs
+            # only when no rectangular placement EXISTS (ranked empty —
+            # its documented purpose), never as a consequence of the
+            # floor pruning — a floor-dependent fallback made the
+            # candidate set discontinuous in the incumbent's value and
+            # slice-order dependent.
             cand = self._connected_candidate(st, req, blocked, axes,
                                              mask=occ_mask)
             if cand is not None:

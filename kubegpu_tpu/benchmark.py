@@ -391,6 +391,70 @@ def _families_bench(cfg, params, on_tpu) -> dict:
         "steps": beam_steps, "e2e_ms": round(beam_s * 1e3, 2),
     }
 
+    # --- continuous batching: arrival-driven serving (models/serve.py) ---
+    from kubegpu_tpu.models.serve import ContinuousBatcher, _engine_fns
+    if on_tpu:
+        cb_slots, cb_prompt, cb_new, cb_stride, cb_reqs = 8, 512, 64, 16, 24
+    else:
+        cb_slots, cb_prompt, cb_new, cb_stride, cb_reqs = 2, 8, 4, 2, 4
+    cb_len = cb_prompt + cb_new + cb_stride + 8
+    cb_p = np.arange(cb_prompt) % cfg.vocab_size
+    # static comparator at the same shape/params/cache dtype
+    cb_sp = prompt_of(cb_slots, cb_prompt, cfg.vocab_size)
+    static_s = _time_calls(
+        lambda: greedy_generate(qparams, cb_sp, cb_new, cfg,
+                                max_len=cb_len),
+        lambda o: o, iters)
+    static_tps = cb_slots * cb_new / static_s
+    # engine end-to-end drain under sustained arrivals (the queue never
+    # empties until the tail): raw wall time includes one host round
+    # trip per tick — subtracted like every other row's end fetch
+    warm = ContinuousBatcher(qparams, cfg, n_slots=cb_slots,
+                             max_len=cb_len, stride=cb_stride,
+                             prompt_buckets=(cb_prompt,))
+    warm.submit(list(cb_p), cb_new)
+    warm.drain()
+    rtt = _fetch_rtt_s(jnp.zeros((4,)))
+    eng = ContinuousBatcher(qparams, cfg, n_slots=cb_slots,
+                            max_len=cb_len, stride=cb_stride,
+                            prompt_buckets=(cb_prompt,))
+    t0 = time.perf_counter()
+    for i in range(cb_reqs):
+        eng.submit(list((cb_p + i) % cfg.vocab_size), cb_new)
+    done = eng.drain()
+    cb_elapsed = time.perf_counter() - t0
+    cb_ticks = eng.slot_steps // (cb_stride * cb_slots)
+    cb_total = sum(len(r.tokens) for r in done)
+    cb_adj = max(cb_elapsed - cb_ticks * rtt, 1e-9)
+    # steady-state DECODE rate, protocol-consistent (chained blocks,
+    # one end fetch): the per-token cost with slots saturated
+    decode_block, _, _ = _engine_fns(cfg, cb_slots, cb_len, cb_stride)
+    from kubegpu_tpu.models.decode import init_kv_cache
+    cb_cache = init_kv_cache(cfg, cb_slots, cb_len)
+    cb_tok = jnp.zeros((cb_slots,), jnp.int32)
+    cb_pos = jnp.full((cb_slots,), cb_prompt, jnp.int32)
+    cb_act = jnp.ones((cb_slots,), bool)
+
+    def chain(st):
+        cache, tok = st
+        blk, tok, _, cache = decode_block(qparams, cache, tok, cb_pos,
+                                          cb_act)
+        return cache, tok   # last element is the end-fetch leaf
+    blk_s, _ = _time_chained(chain, (cb_cache, cb_tok),
+                             iters=max(iters * 3, 4))
+    out["continuous_batching"] = {
+        "n_slots": cb_slots, "prompt_len": cb_prompt,
+        "new_tokens": cb_new, "stride": cb_stride,
+        "requests": cb_reqs,
+        "occupancy": round(eng.occupancy, 3),
+        "e2e_ms_raw": round(cb_elapsed * 1e3, 1),
+        "e2e_tokens_per_s_rtt_adjusted": round(cb_total / cb_adj, 1),
+        "decode_tokens_per_s": round(
+            cb_slots * cb_stride / blk_s, 1),
+        "static_e2e_tokens_per_s": round(static_tps, 1),
+        "vs_static_e2e": round(cb_total / cb_adj / static_tps, 3),
+    }
+
     sp = prompt_of(spec_b, spec_t, cfg.vocab_size)
     spec_len = spec_t + spec_steps
     dl = max(1, cfg.n_layers // 4)

@@ -227,10 +227,15 @@ class CriServer:
                 raise CriError(
                     f"pod {pod_name} has no container {container_name!r}")
             index = names.index(container_name)
-        # kubelet's pull-serialize contract: the image must be present
-        # before create (a real runtime fails with "image not found")
-        ref = ((config.get("image") or {}).get("image")
-               or pod.spec.containers[index].image)
+        # kubelet's pull-serialize contract: the image the container
+        # will actually RUN (the pod spec's — what the shim consumes)
+        # must be present before create; a differing client-supplied
+        # config ref is a stale-manifest error, not a loophole
+        ref = pod.spec.containers[index].image
+        cfg_ref = (config.get("image") or {}).get("image")
+        if cfg_ref and cfg_ref != ref:
+            raise CriError(
+                f"config image {cfg_ref!r} != pod spec image {ref!r}")
         with self._lock:
             present = ref in self._images
         if not present:

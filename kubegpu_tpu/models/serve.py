@@ -1,0 +1,334 @@
+"""Continuous batching — arrival-driven serving over the cached forward.
+
+The r2 serving stack is batch-static: every sequence in a
+``greedy_generate`` call starts and ends together.  Real serving is
+arrival-driven; the structural piece this module adds (VERDICT r2 next
+item #7) is the SLOT engine:
+
+- the KV cache is ``n_slots`` independent batch rows with PER-SLOT
+  positions — a slot is admitted, decodes, retires, and is re-admitted
+  without disturbing its neighbors;
+- an arriving request is prefilled at batch 1 (prompt right-padded to a
+  compile bucket) and its K/V panel is scattered into a free slot's
+  rows — admission never re-traces the decode executable;
+- decode advances ALL slots in one executable with per-row positions:
+  rope takes a [B, 1] position matrix, the cache write is a vmapped
+  ``dynamic_update_slice`` (one row offset per slot, lowered to a
+  scatter), and the causal/unwritten mask compares each row's own
+  position;
+- host interaction is STRIDE-amortized: ``lax.scan`` runs N decode
+  steps per dispatch and the host fetches one [stride, B] token block
+  — under the async TPU tunnel a per-step fetch costs ~100× the step
+  itself (the r2 speculative host loop measured exactly that), and
+  even locally it serializes dispatch.  Admission/retirement granularity
+  is the stride.
+
+Correctness contract: slots are independent batch rows, so a request's
+tokens are bit-identical (in f32) to a solo ``greedy_generate`` of the
+same prompt — asserted in tests with staggered arrivals.  Right-pad
+garbage is never attended: pad rows sit at positions ≥ the row's
+true length, the per-row mask hides ``k_pos > q_pos``, and generation
+overwrites each row before its position becomes visible (the same
+overwrite-before-attend invariant the speculative verifier relies on).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kubegpu_tpu.models.decode import (
+    _dense_ffn,
+    init_kv_cache,
+)
+from kubegpu_tpu.models.llama import LlamaConfig, _rmsnorm, _rope
+from kubegpu_tpu.ops.flash_attention import NEG_INF
+
+
+# ---------------------------------------------------------------------------
+# Per-row-position forward (the continuous-batching decode step)
+# ---------------------------------------------------------------------------
+
+def _attend_rows(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                 pos: jax.Array) -> jax.Array:
+    """Grouped cached attention with PER-ROW query positions.
+    q: [B, Hq, 1, D]; cache [B, Hkv, S, D]; pos: [B] (this step's global
+    position per slot).  Row b attends keys at ``k_pos <= pos[b]``."""
+    b, hq, t, d = q.shape
+    hkv, s = ck.shape[1], ck.shape[2]
+    qg = q.reshape(b, hkv, hq // hkv, t, d)
+    scale = d ** -0.5
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, ck,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(s)
+    mask = k_pos[None, :] <= pos[:, None]              # [B, S]
+    scores = jnp.where(mask[:, None, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bksd->bkgtd", probs, cv,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, hq, t, d).astype(q.dtype)
+
+
+def _row_step(params: dict, tokens: jax.Array, cache: dict,
+              pos: jax.Array, cfg: LlamaConfig) -> tuple[jax.Array, dict]:
+    """One decode step for every slot at its OWN position.
+    tokens: [B] current token per slot; pos: [B] its global position.
+    Returns (next-token logits [B, V] f32, updated cache)."""
+    b = tokens.shape[0]
+    hd = cfg.head_dim
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]   # [B,1,D]
+    positions = pos[:, None]                                    # [B,1]
+
+    def write_row(c, kv, p):
+        # one slot's cache panel [Hkv, S, D] ← its new row at p
+        return lax.dynamic_update_slice(c, kv.astype(c.dtype), (0, p, 0))
+
+    def layer(x, xs):
+        lp, ck, cv = xs
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, 1, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(b, 1, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
+        q = _rope(q, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
+        k = _rope(k, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)                     # [B,Hkv,1,D]
+        ck = jax.vmap(write_row)(ck, k, pos)
+        cv = jax.vmap(write_row)(cv, v, pos)
+        o = _attend_rows(q, ck, cv, pos)
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.n_heads * hd)
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        return _dense_ffn(x, lp, cfg), (ck, cv)
+
+    x, (ck_new, cv_new) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits[:, 0], {"k": ck_new, "v": cv_new}
+
+
+@functools.lru_cache(maxsize=32)
+def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
+                stride: int):
+    """Jitted engine pieces, cached per static signature."""
+
+    @jax.jit
+    def decode_block(params, cache, tokens, pos, active):
+        """``stride`` decode steps for all slots in ONE dispatch.
+        Greedy feedback per slot; inactive slots hold position (their
+        garbage output is never emitted and their rows never advance).
+        Returns (token block [stride, B], last tokens, pos', cache)."""
+
+        def step(carry, _):
+            tokens, pos, cache = carry
+            logits, cache = _row_step(params, tokens, cache, pos, cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+            nxt = jnp.where(active, nxt, tokens)
+            pos = jnp.where(active, pos + 1, pos)
+            return (nxt, pos, cache), nxt
+
+        (tokens, pos, cache), block = lax.scan(
+            step, (tokens, pos, cache), None, length=stride)
+        return block, tokens, pos, cache
+
+    @jax.jit
+    def prefill_one(params, padded_prompt, true_len):
+        """Batch-1 prefill on a right-padded prompt (the padded shape
+        keys the compile cache — one executable per bucket).  Returns
+        (first generated token [1], batch-1 cache); the first token is
+        the argmax at the TRUE last prompt position (pad logits
+        ignored)."""
+        from kubegpu_tpu.models.decode import _forward_with_cache
+        cache1 = init_kv_cache(cfg, 1, max_len)
+        logits, cache1 = _forward_with_cache(
+            params, padded_prompt, cache1, jnp.int32(0), cfg)
+        last = lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
+                                        keepdims=False)     # [1, V]
+        return jnp.argmax(last, axis=-1).astype(jnp.int32), cache1
+
+    @jax.jit
+    def adopt_slot(cache, cache1, slot, first, plen,
+                   first_toks, tokens, pos):
+        """Admit in ONE dispatch: scatter a batch-1 cache into slot row
+        ``slot`` and update every per-slot device vector.  (A handful
+        of eager ``.at[].set`` ops per admission each cost a dispatch —
+        under the tunnel that overhead rivaled the decode itself.)"""
+        cache = jax.tree.map(
+            lambda big, one: lax.dynamic_update_slice(
+                big, one.astype(big.dtype), (0, slot, 0, 0, 0)),
+            cache, cache1)
+        first_toks = lax.dynamic_update_slice(first_toks, first, (slot,))
+        tokens = lax.dynamic_update_slice(tokens, first, (slot,))
+        pos = lax.dynamic_update_slice(pos, plen[None], (slot,))
+        return cache, first_toks, tokens, pos
+
+    return decode_block, prefill_one, adopt_slot
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Request:
+    rid: int
+    prompt_len: int
+    max_new_tokens: int
+    tokens: list[int] = field(default_factory=list)   # generated so far
+    done: bool = False
+
+
+class ContinuousBatcher:
+    """Slot-based continuous-batching engine (greedy decode).
+
+    ``submit()`` enqueues a request; ``step()`` admits pending requests
+    into free slots (batch-1 prefill + cache scatter), runs ONE
+    stride-block of decode steps for every slot, and returns the
+    requests that finished.  ``prompt_buckets`` are the padded prompt
+    lengths prefill compiles for (one executable per bucket)."""
+
+    def __init__(self, params: dict, cfg: LlamaConfig, n_slots: int = 8,
+                 max_len: int | None = None, stride: int = 16,
+                 prompt_buckets: tuple[int, ...] = (128, 512, 1024)):
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.stride = stride
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        if self.prompt_buckets[-1] >= self.max_len:
+            raise ValueError("largest prompt bucket must be < max_len")
+        self._fns = _engine_fns(cfg, n_slots, self.max_len, stride)
+        self.cache = init_kv_cache(cfg, n_slots, self.max_len)
+        self.tokens = jnp.zeros((n_slots,), jnp.int32)
+        self.pos = jnp.zeros((n_slots,), jnp.int32)
+        # the active mask lives HOST-side (numpy) and uploads with the
+        # block dispatch — mutating it at retirement must not cost a
+        # device op per request
+        self.active = np.zeros((n_slots,), bool)
+        # per-slot prefill-produced first token, kept ON DEVICE until
+        # the next tick's single fused fetch — admissions must add zero
+        # host round trips (under the TPU tunnel one fetch costs ~100
+        # decode steps; the naive per-admission int() sync dominated
+        # the first on-chip measurement)
+        self.first_toks = jnp.zeros((n_slots,), jnp.int32)
+        self.slot_req: dict[int, _Request] = {}
+        self.queue: deque[tuple[_Request, jax.Array]] = deque()
+        self._next_rid = 0
+        # generated-token bookkeeping (totals; the bench's numerator)
+        self.emitted_tokens = 0      # all generated tokens (incl. the
+        #                              prefill-produced first token)
+        self._decode_tokens = 0      # tokens produced BY decode steps
+        self.slot_steps = 0          # decode slot-steps spent
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Enqueue a request.  ``prompt``: 1-D int sequence."""
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        prompt = jnp.asarray(prompt, jnp.int32)
+        t = int(prompt.shape[0])
+        bucket = next((b for b in self.prompt_buckets if b >= t), None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {t} exceeds largest bucket "
+                f"{self.prompt_buckets[-1]}")
+        if t + max_new_tokens + self.stride > self.max_len:
+            raise ValueError(
+                f"prompt {t} + max_new {max_new_tokens} + stride "
+                f"{self.stride} > max_len {self.max_len}")
+        padded = jnp.zeros((1, bucket), jnp.int32).at[0, :t].set(prompt)
+        req = _Request(rid=self._next_rid, prompt_len=t,
+                       max_new_tokens=max_new_tokens)
+        self._next_rid += 1
+        self.queue.append((req, padded))
+        return req.rid
+
+    # -- the engine tick ------------------------------------------------
+
+    def _admit(self) -> None:
+        decode_block, prefill_one, adopt_slot = self._fns
+        free = [s for s in range(self.n_slots)
+                if s not in self.slot_req]
+        while free and self.queue:
+            slot = free.pop(0)
+            req, padded = self.queue.popleft()
+            first, cache1 = prefill_one(self.params, padded,
+                                        req.prompt_len)
+            # two dispatches per admission, zero host fetches: the
+            # first token's value reaches req.tokens at the next tick's
+            # fused fetch
+            (self.cache, self.first_toks, self.tokens,
+             self.pos) = adopt_slot(
+                self.cache, cache1, jnp.int32(slot), first,
+                jnp.int32(req.prompt_len), self.first_toks,
+                self.tokens, self.pos)
+            self.active[slot] = req.max_new_tokens > 1
+            self.slot_req[slot] = req
+            self.emitted_tokens += 1
+            if req.max_new_tokens <= 1:
+                req.done = True
+
+    def step(self) -> list[_Request]:
+        """One engine tick: admit, decode one stride block, retire.
+        Returns the requests that FINISHED this tick.  Exactly ONE host
+        round trip happens per tick: the token block and every pending
+        first token travel in one fused fetch."""
+        decode_block, prefill_one, adopt_slot = self._fns
+        self._admit()
+        finished: list[_Request] = []
+        if not self.slot_req:
+            return finished
+        block, self.tokens, self.pos, self.cache = decode_block(
+            self.params, self.cache, self.tokens, self.pos,
+            jnp.asarray(self.active))
+        nb = self.stride * self.n_slots
+        fused = np.asarray(jnp.concatenate(
+            [block.reshape(-1), self.first_toks]))
+        block_np = fused[:nb].reshape(self.stride, self.n_slots)
+        firsts_np = fused[nb:]
+        self.slot_steps += self.stride * self.n_slots
+        for slot, req in list(self.slot_req.items()):
+            if not req.tokens:   # first token materializes on fetch
+                req.tokens.append(int(firsts_np[slot]))
+            if req.done:   # single-token request: retires without decode
+                finished.append(req)
+                del self.slot_req[slot]
+                self.active[slot] = False
+                continue
+            want = req.max_new_tokens - len(req.tokens)
+            take = min(self.stride, want)
+            req.tokens.extend(int(x) for x in block_np[:take, slot])
+            self.emitted_tokens += take
+            self._decode_tokens += take
+            if len(req.tokens) >= req.max_new_tokens:
+                req.done = True
+                finished.append(req)
+                del self.slot_req[slot]
+                self.active[slot] = False
+        return finished
+
+    def drain(self, max_ticks: int = 10_000) -> list[_Request]:
+        """Run until queue and slots are empty; returns every finished
+        request in completion order."""
+        out: list[_Request] = []
+        for _ in range(max_ticks):
+            if not self.queue and not self.slot_req:
+                return out
+            out.extend(self.step())
+        raise RuntimeError("drain did not converge")
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of decode slot-steps whose token was consumed by a
+        request (the prefill-produced first token is throughput but not
+        a decode step, so it does not count here)."""
+        return (self._decode_tokens / self.slot_steps
+                if self.slot_steps else 0.0)

@@ -33,7 +33,12 @@ class TestModelBench:
         # tiny CPU path so a missing row fails before a hardware run
         fam = out["families"]
         assert set(fam) == {"moe_serving", "t5_serving", "lora",
-                            "beam", "spec_decode"}
+                            "beam", "spec_decode",
+                            "continuous_batching"}
+        cb = fam["continuous_batching"]
+        assert cb["e2e_tokens_per_s_rtt_adjusted"] > 0
+        assert cb["decode_tokens_per_s"] > 0
+        assert 0 < cb["occupancy"] <= 1
         assert fam["moe_serving"]["gen_tokens_per_s_e2e"] > 0
         assert fam["t5_serving"]["gen_tokens_per_s_e2e"] > 0
         assert fam["lora"]["step_ms"] > 0
