@@ -740,18 +740,18 @@ class GangAllocator:
                                          frag=frag)
             if cand and (best is None or cand.score > best.score):
                 best = cand
-        if best is None and (not ranked or incumbent is None):
+        if not ranked:
             # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) fall back
             # to a connected free set — the reference's group allocator had
             # the same flexibility since groups weren't geometric.
-            # Gating (r3 review, twice-revised): with NO incumbent this
-            # is exactly the pre-r3 rule (fallback when nothing
-            # rectangular scored); with an incumbent the fallback runs
-            # only when no rectangular placement EXISTS (ranked empty —
-            # its documented purpose), never as a consequence of the
-            # floor pruning — a floor-dependent fallback made the
-            # candidate set discontinuous in the incumbent's value and
-            # slice-order dependent.
+            # Eligibility is `not ranked` — a pure function of (slice
+            # occupancy, request), NEVER of the cross-slice incumbent
+            # (r3 review, thrice-revised: any floor-dependent gate makes
+            # the returned assignment depend on slice iteration order).
+            # The theoretical corner this forgoes — rectangular
+            # placements exist but every candidate ordering fails the
+            # host-chunking filter — is fuzz-covered as unplaceable-by-
+            # rectangles, and treating it as such keeps determinism.
             cand = self._connected_candidate(st, req, blocked, axes,
                                              mask=occ_mask)
             if cand is not None:

@@ -44,10 +44,12 @@ import numpy as np
 from jax import lax
 
 from kubegpu_tpu.models.decode import (
+    _attn_finish,
     _dense_ffn,
+    _project_qkv,
     init_kv_cache,
 )
-from kubegpu_tpu.models.llama import LlamaConfig, _rmsnorm, _rope
+from kubegpu_tpu.models.llama import LlamaConfig, _rmsnorm
 from kubegpu_tpu.ops.flash_attention import NEG_INF
 
 
@@ -80,8 +82,6 @@ def _row_step(params: dict, tokens: jax.Array, cache: dict,
     """One decode step for every slot at its OWN position.
     tokens: [B] current token per slot; pos: [B] its global position.
     Returns (next-token logits [B, V] f32, updated cache)."""
-    b = tokens.shape[0]
-    hd = cfg.head_dim
     x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]   # [B,1,D]
     positions = pos[:, None]                                    # [B,1]
 
@@ -92,18 +92,13 @@ def _row_step(params: dict, tokens: jax.Array, cache: dict,
     def layer(x, xs):
         lp, ck, cv = xs
         h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, 1, cfg.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(b, 1, cfg.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
-        q = _rope(q, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
-        k = _rope(k, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
-        v = v.transpose(0, 2, 1, 3)                     # [B,Hkv,1,D]
+        q, k, v = _project_qkv(h, lp, cfg, positions)   # [B,H,1,D]
         ck = jax.vmap(write_row)(ck, k, pos)
         cv = jax.vmap(write_row)(cv, v, pos)
         o = _attend_rows(q, ck, cv, pos)
-        o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.n_heads * hd)
-        x = x + (o @ lp["wo"]).astype(x.dtype)
-        return _dense_ffn(x, lp, cfg), (ck, cv)
+        return _attn_finish(
+            x, o, lp, cfg,
+            lambda x_, lp_: _dense_ffn(x_, lp_, cfg)), (ck, cv)
 
     x, (ck_new, cv_new) = lax.scan(
         layer, x, (params["layers"], cache["k"], cache["v"]))
@@ -235,6 +230,10 @@ class ContinuousBatcher:
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
         prompt = jnp.asarray(prompt, jnp.int32)
         t = int(prompt.shape[0])
+        if t < 1:
+            # an empty prompt would index prefill logits at -1, which
+            # dynamic_index clamps to 0 — silent garbage, not an error
+            raise ValueError("prompt must have at least one token")
         bucket = next((b for b in self.prompt_buckets if b >= t), None)
         if bucket is None:
             raise ValueError(
