@@ -214,6 +214,7 @@ class ContinuousBatcher:
         self.first_toks = jnp.zeros((n_slots,), jnp.int32)
         self.slot_req: dict[int, _Request] = {}
         self.queue: deque[tuple[_Request, jax.Array]] = deque()
+        self._inflight: jax.Array | None = None   # fused (block, firsts)
         self._next_rid = 0
         # generated-token bookkeeping (totals; the bench's numerator)
         self.emitted_tokens = 0      # all generated tokens (incl. the
@@ -276,21 +277,37 @@ class ContinuousBatcher:
                 req.done = True
 
     def step(self) -> list[_Request]:
-        """One engine tick: admit, decode one stride block, retire.
-        Returns the requests that FINISHED this tick.  Exactly ONE host
-        round trip happens per tick: the token block and every pending
-        first token travel in one fused fetch."""
-        decode_block, prefill_one, adopt_slot = self._fns
+        """One engine tick: collect the previous tick's in-flight block,
+        retire its finishers, admit into the freed slots, then dispatch
+        the next block and return WITHOUT waiting for it.  One fused
+        host round trip per tick (token block + every pending first
+        token).  Because the dispatch is asynchronous, the block
+        computes during whatever the caller does between ticks (e.g. an
+        async server accepting submissions) — and since collection
+        precedes dispatch, membership is always current: a finisher
+        retires before the next block runs.  Returns the requests that
+        FINISHED (from the block dispatched last tick)."""
+        decode_block, _, _ = self._fns
+        finished = self._collect()
         self._admit()
+        if self.slot_req:
+            block, self.tokens, self.pos, self.cache = decode_block(
+                self.params, self.cache, self.tokens, self.pos,
+                jnp.asarray(self.active))
+            # fuse NOW (after admissions): newly admitted requests'
+            # first tokens ride this block's fetch
+            self._inflight = jnp.concatenate(
+                [block.reshape(-1), self.first_toks])
+        return finished
+
+    def _collect(self) -> list[_Request]:
+        """Fetch + account the in-flight block, if any."""
         finished: list[_Request] = []
-        if not self.slot_req:
+        if self._inflight is None:
             return finished
-        block, self.tokens, self.pos, self.cache = decode_block(
-            self.params, self.cache, self.tokens, self.pos,
-            jnp.asarray(self.active))
+        fused = np.asarray(self._inflight)    # THE host sync
+        self._inflight = None
         nb = self.stride * self.n_slots
-        fused = np.asarray(jnp.concatenate(
-            [block.reshape(-1), self.first_toks]))
         block_np = fused[:nb].reshape(self.stride, self.n_slots)
         firsts_np = fused[nb:]
         self.slot_steps += self.stride * self.n_slots
