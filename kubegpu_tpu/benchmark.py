@@ -435,10 +435,14 @@ def _families_bench(cfg, params, on_tpu) -> dict:
     cb_pos = jnp.full((cb_slots,), cb_prompt, jnp.int32)
     cb_act = jnp.ones((cb_slots,), bool)
 
+    cb_temps = jnp.zeros((cb_slots,), jnp.float32)   # all-greedy slots
+    cb_key = jax.random.PRNGKey(0)
+
     def chain(st):
         cache, tok = st
         blk, tok, _, cache = decode_block(qparams, cache, tok, cb_pos,
-                                          cb_act)
+                                          cb_act, cb_temps, cb_key,
+                                          jnp.int32(0))
         return cache, tok   # last element is the end-fetch leaf
     blk_s, _ = _time_chained(chain, (cb_cache, cb_tok),
                              iters=max(iters * 3, 4))

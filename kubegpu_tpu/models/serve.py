@@ -109,46 +109,71 @@ def _row_step(params: dict, tokens: jax.Array, cache: dict,
 
 @functools.lru_cache(maxsize=32)
 def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
-                stride: int):
-    """Jitted engine pieces, cached per static signature."""
+                stride: int, top_k: int = 0):
+    """Jitted engine pieces, cached per static signature.  ``top_k``
+    is the engine-wide truncation for sampled slots (static: per-slot
+    k would be shape-dynamic); per-REQUEST temperature rides a [B]
+    vector — 0 means greedy for that slot."""
+
+    def _pick(logits, temps, k_):
+        """Per-slot token selection: greedy where temps == 0, else the
+        shared :func:`decode._sample_token` draw (temperature-scaled,
+        top-k-truncated) — the truncation math exists exactly once;
+        only the per-row greedy/sampled blend is this engine's."""
+        from kubegpu_tpu.models.decode import _sample_token
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = _sample_token(logits, k_, temps[:, None],
+                                jnp.float32(1.0), top_k, nucleus=False)
+        return jnp.where(temps > 0, sampled, greedy)
 
     @jax.jit
-    def decode_block(params, cache, tokens, pos, active):
+    def decode_block(params, cache, tokens, pos, active, temps,
+                     base_key, tick):
         """``stride`` decode steps for all slots in ONE dispatch.
-        Greedy feedback per slot; inactive slots hold position (their
-        garbage output is never emitted and their rows never advance).
-        Returns (token block [stride, B], last tokens, pos', cache)."""
+        Per-slot greedy/sampled feedback; inactive slots hold position
+        (their garbage output is never emitted and their rows never
+        advance).  The tick folds into the key INSIDE the jit (an
+        eager fold_in would cost dispatches on an engine built to
+        avoid them).  Returns (token block [stride, B], last tokens,
+        pos', cache)."""
+        keys = jax.random.split(
+            jax.random.fold_in(jax.random.fold_in(base_key, 0), tick),
+            stride)
 
-        def step(carry, _):
+        def step(carry, k_):
             tokens, pos, cache = carry
             logits, cache = _row_step(params, tokens, cache, pos, cfg)
-            nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+            nxt = _pick(logits, temps, k_).astype(tokens.dtype)
             nxt = jnp.where(active, nxt, tokens)
             pos = jnp.where(active, pos + 1, pos)
             return (nxt, pos, cache), nxt
 
         (tokens, pos, cache), block = lax.scan(
-            step, (tokens, pos, cache), None, length=stride)
+            step, (tokens, pos, cache), keys)
         return block, tokens, pos, cache
 
     @jax.jit
-    def prefill_one(params, padded_prompt, true_len):
+    def prefill_one(params, padded_prompt, true_len, temp, base_key,
+                    rid):
         """Batch-1 prefill on a right-padded prompt (the padded shape
         keys the compile cache — one executable per bucket).  Returns
         (first generated token [1], batch-1 cache); the first token is
-        the argmax at the TRUE last prompt position (pad logits
-        ignored)."""
+        picked at the TRUE last prompt position (pad logits ignored),
+        greedy or sampled per the request's temperature.  The rid
+        folds into the key inside the jit (separate domain from the
+        block keys via the leading 1)."""
         from kubegpu_tpu.models.decode import _forward_with_cache
         cache1 = init_kv_cache(cfg, 1, max_len)
         logits, cache1 = _forward_with_cache(
             params, padded_prompt, cache1, jnp.int32(0), cfg)
         last = lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                         keepdims=False)     # [1, V]
-        return jnp.argmax(last, axis=-1).astype(jnp.int32), cache1
+        key = jax.random.fold_in(jax.random.fold_in(base_key, 1), rid)
+        return _pick(last, temp[None], key).astype(jnp.int32), cache1
 
     @jax.jit
-    def adopt_slot(cache, cache1, slot, first, plen,
-                   first_toks, tokens, pos):
+    def adopt_slot(cache, cache1, slot, first, plen, temp,
+                   first_toks, tokens, pos, temps):
         """Admit in ONE dispatch: scatter a batch-1 cache into slot row
         ``slot`` and update every per-slot device vector.  (A handful
         of eager ``.at[].set`` ops per admission each cost a dispatch —
@@ -160,7 +185,8 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
         first_toks = lax.dynamic_update_slice(first_toks, first, (slot,))
         tokens = lax.dynamic_update_slice(tokens, first, (slot,))
         pos = lax.dynamic_update_slice(pos, plen[None], (slot,))
-        return cache, first_toks, tokens, pos
+        temps = lax.dynamic_update_slice(temps, temp[None], (slot,))
+        return cache, first_toks, tokens, pos, temps
 
     return decode_block, prefill_one, adopt_slot
 
@@ -174,22 +200,30 @@ class _Request:
     rid: int
     prompt_len: int
     max_new_tokens: int
+    temperature: float = 0.0     # 0 = greedy
     tokens: list[int] = field(default_factory=list)   # generated so far
     done: bool = False
 
 
 class ContinuousBatcher:
-    """Slot-based continuous-batching engine (greedy decode).
+    """Slot-based continuous-batching engine.
 
-    ``submit()`` enqueues a request; ``step()`` admits pending requests
-    into free slots (batch-1 prefill + cache scatter), runs ONE
-    stride-block of decode steps for every slot, and returns the
-    requests that finished.  ``prompt_buckets`` are the padded prompt
-    lengths prefill compiles for (one executable per bucket)."""
+    ``submit()`` enqueues a request (greedy by default; a positive
+    ``temperature`` samples that request with the engine's static
+    ``top_k`` truncation, deterministically per ``seed``); ``step()``
+    admits pending requests into free slots (batch-1 prefill + cache
+    scatter), runs ONE stride-block of decode steps for every slot,
+    and returns the requests that finished.  ``prompt_buckets`` are
+    the padded prompt lengths prefill compiles for (one executable per
+    bucket)."""
 
     def __init__(self, params: dict, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: int | None = None, stride: int = 16,
-                 prompt_buckets: tuple[int, ...] = (128, 512, 1024)):
+                 prompt_buckets: tuple[int, ...] = (128, 512, 1024),
+                 top_k: int = 0, seed: int = 0):
+        if not 0 <= top_k <= cfg.vocab_size:
+            raise ValueError(
+                f"top_k {top_k} not in [0, vocab_size={cfg.vocab_size}]")
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -198,10 +232,16 @@ class ContinuousBatcher:
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         if self.prompt_buckets[-1] >= self.max_len:
             raise ValueError("largest prompt bucket must be < max_len")
-        self._fns = _engine_fns(cfg, n_slots, self.max_len, stride)
+        self._fns = _engine_fns(cfg, n_slots, self.max_len, stride,
+                                top_k)
         self.cache = init_kv_cache(cfg, n_slots, self.max_len)
         self.tokens = jnp.zeros((n_slots,), jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
+        self.temps = jnp.zeros((n_slots,), jnp.float32)
+        # deterministic sampling: prefill keys derive from the rid,
+        # block keys from the tick counter — no device-side key state
+        self._base_key = jax.random.PRNGKey(seed)
+        self._tick = 0
         # the active mask lives HOST-side (numpy) and uploads with the
         # block dispatch — mutating it at retirement must not cost a
         # device op per request
@@ -224,11 +264,16 @@ class ContinuousBatcher:
 
     # -- submission -----------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
-        """Enqueue a request.  ``prompt``: 1-D int sequence."""
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0) -> int:
+        """Enqueue a request.  ``prompt``: 1-D int sequence;
+        ``temperature`` 0 decodes greedily, > 0 samples."""
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
         prompt = jnp.asarray(prompt, jnp.int32)
         t = int(prompt.shape[0])
         if t < 1:
@@ -246,7 +291,8 @@ class ContinuousBatcher:
                 f"{self.stride} > max_len {self.max_len}")
         padded = jnp.zeros((1, bucket), jnp.int32).at[0, :t].set(prompt)
         req = _Request(rid=self._next_rid, prompt_len=t,
-                       max_new_tokens=max_new_tokens)
+                       max_new_tokens=max_new_tokens,
+                       temperature=float(temperature))
         self._next_rid += 1
         self.queue.append((req, padded))
         return req.rid
@@ -260,16 +306,19 @@ class ContinuousBatcher:
         while free and self.queue:
             slot = free.pop(0)
             req, padded = self.queue.popleft()
-            first, cache1 = prefill_one(self.params, padded,
-                                        req.prompt_len)
+            first, cache1 = prefill_one(
+                self.params, padded, req.prompt_len,
+                jnp.float32(req.temperature), self._base_key,
+                jnp.int32(req.rid))
             # two dispatches per admission, zero host fetches: the
             # first token's value reaches req.tokens at the next tick's
             # fused fetch
             (self.cache, self.first_toks, self.tokens,
-             self.pos) = adopt_slot(
+             self.pos, self.temps) = adopt_slot(
                 self.cache, cache1, jnp.int32(slot), first,
-                jnp.int32(req.prompt_len), self.first_toks,
-                self.tokens, self.pos)
+                jnp.int32(req.prompt_len),
+                jnp.float32(req.temperature), self.first_toks,
+                self.tokens, self.pos, self.temps)
             self.active[slot] = req.max_new_tokens > 1
             self.slot_req[slot] = req
             self.emitted_tokens += 1
@@ -293,7 +342,9 @@ class ContinuousBatcher:
         if self.slot_req:
             block, self.tokens, self.pos, self.cache = decode_block(
                 self.params, self.cache, self.tokens, self.pos,
-                jnp.asarray(self.active))
+                jnp.asarray(self.active), self.temps, self._base_key,
+                jnp.int32(self._tick))
+            self._tick += 1
             # fuse NOW (after admissions): newly admitted requests'
             # first tokens ride this block's fetch
             self._inflight = jnp.concatenate(
