@@ -327,12 +327,21 @@ def _families_bench(cfg, params, on_tpu) -> dict:
 
     out = {}
 
-    # --- MoE serving: routed-expert decode, int8 KV cache ---
+    # --- MoE serving: routed-expert decode, int8 KV cache; int8
+    # weights are the big lever here — top-2-of-8 routing still
+    # streams ALL expert weights every step, so halving their bytes
+    # is ~2x (measured 1.9x) ---
+    from kubegpu_tpu.models.quant import quantize_moe
     moe_params = moe_init(jax.random.PRNGKey(1), moe_cfg)
     mp = prompt_of(moe_b, moe_t, moe_cfg.base.vocab_size)
     moe_len = moe_t + moe_steps
     moe_s = _time_calls(
         lambda: moe_greedy_generate(moe_params, mp, moe_steps, moe_cfg,
+                                    max_len=moe_len, kv_int8=True),
+        lambda o: o, iters)
+    moe_q = quantize_moe(moe_params)
+    moe_qs = _time_calls(
+        lambda: moe_greedy_generate(moe_q, mp, moe_steps, moe_cfg,
                                     max_len=moe_len, kv_int8=True),
         lambda o: o, iters)
     out["moe_serving"] = {
@@ -341,14 +350,22 @@ def _families_bench(cfg, params, on_tpu) -> dict:
         "batch": moe_b, "prompt_len": moe_t, "steps": moe_steps,
         "e2e_ms": round(moe_s * 1e3, 2),
         "gen_tokens_per_s_e2e": round(moe_b * moe_steps / moe_s, 1),
+        "int8_gen_tokens_per_s_e2e": round(
+            moe_b * moe_steps / moe_qs, 1),
+        "int8_speedup": round(moe_s / moe_qs, 2),
     }
-    del moe_params
+    del moe_params, moe_q
 
-    # --- T5 serving: encode once + cached decode ---
+    # --- T5 serving: encode once + cached decode (bf16 and int8) ---
+    from kubegpu_tpu.models.quant import quantize_t5
     t5_params = t5_init(jax.random.PRNGKey(2), t5_cfg)
     tp = prompt_of(t5_b, t5_t, t5_cfg.vocab_size)
     t5_s = _time_calls(
         lambda: t5_greedy_generate(t5_params, tp, t5_steps, t5_cfg),
+        lambda o: o, iters)
+    t5_q = quantize_t5(t5_params)
+    t5_qs = _time_calls(
+        lambda: t5_greedy_generate(t5_q, tp, t5_steps, t5_cfg),
         lambda o: o, iters)
     out["t5_serving"] = {
         "params_m": round(sum(
@@ -356,8 +373,11 @@ def _families_bench(cfg, params, on_tpu) -> dict:
         "batch": t5_b, "enc_len": t5_t, "steps": t5_steps,
         "e2e_ms": round(t5_s * 1e3, 2),
         "gen_tokens_per_s_e2e": round(t5_b * t5_steps / t5_s, 1),
+        "int8_gen_tokens_per_s_e2e": round(
+            t5_b * t5_steps / t5_qs, 1),
+        "int8_speedup": round(t5_s / t5_qs, 2),
     }
-    del t5_params
+    del t5_params, t5_q
 
     # --- LoRA fine-tune step on the flagship params ---
     lcfg = LoRAConfig(rank=8)

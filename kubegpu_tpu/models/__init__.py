@@ -35,7 +35,12 @@ from kubegpu_tpu.models.lora import (
     lora_param_specs,
     make_lora_train_step,
 )
-from kubegpu_tpu.models.quant import QTensor, quantize_llama
+from kubegpu_tpu.models.quant import (
+    QTensor,
+    quantize_llama,
+    quantize_moe,
+    quantize_t5,
+)
 from kubegpu_tpu.models.t5 import (
     T5Config,
     t5_decode_step,
@@ -61,7 +66,7 @@ __all__ = [
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
     "sample_generate", "beam_generate", "spec_generate", "draft_view",
-    "QTensor", "quantize_llama",
+    "QTensor", "quantize_llama", "quantize_moe", "quantize_t5",
     "LoRAConfig", "lora_init", "lora_merge", "lora_param_specs",
     "make_lora_train_step",
 ]

@@ -94,28 +94,73 @@ def quantize(w: jax.Array, batch_dims: int = 0) -> QTensor:
     return QTensor(q, scale)
 
 
+def quantize_tree(params: dict, quant_keys: frozenset,
+                  stacked_subtrees: frozenset,
+                  stacked_batch_dims: dict | None = None) -> dict:
+    """Quantize the named matmul-weight leaves of a parameter tree in
+    one pass.  Keys under a subtree named in ``stacked_subtrees`` are
+    stacked ``[L, ...]`` weights and get per-(layer, channel) scales;
+    ``stacked_batch_dims`` overrides the preserved leading axes for
+    specific stacked keys (e.g. MoE's ``[L, E, ...]`` expert weights
+    need 2).  Works for any family whose forward consumes weights only
+    via ``@`` (the QTensor overload boundary)."""
+    overrides = stacked_batch_dims or {}
+
+    def walk(tree, stacked: bool):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = walk(v, stacked=(k in stacked_subtrees))
+            elif k in quant_keys:
+                bd = overrides.get(k, 1) if stacked else 0
+                out[k] = quantize(v, batch_dims=bd)
+            else:
+                out[k] = v
+        return out
+    return walk(params, stacked=False)
+
+
 # Llama param-tree leaves worth quantizing: the big matmul weights.
 # Norm scales are tiny; embed feeds `take`; biases don't exist.
 _LLAMA_QUANT_KEYS = frozenset(
     {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"})
+
+# T5: encoder attn (w*), decoder self (s*) + cross (c*) attn, the
+# gated-GELU FFN, and the head.  Relative-bias tables feed `take` and
+# stay full precision, like Llama's embedding.
+_T5_QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "sq", "sk", "sv", "so",
+     "cq", "ck", "cv", "co", "wi_0", "wi_1", "wo_ff", "lm_head"})
 
 
 def quantize_llama(params: dict) -> dict:
     """Quantize a Llama/decode parameter tree in one pass; the result
     drops into ``llama_forward`` / ``prefill`` / ``decode_step`` /
     ``greedy_generate`` unchanged (weights are only used via ``@``)."""
-    def walk(tree, stacked: bool):
-        out = {}
-        for k, v in tree.items():
-            if isinstance(v, dict):
-                # the "layers" subtree holds stacked [L, ...] weights
-                out[k] = walk(v, stacked=(k == "layers"))
-            elif k in _LLAMA_QUANT_KEYS:
-                out[k] = quantize(v, batch_dims=1 if stacked else 0)
-            else:
-                out[k] = v
-        return out
-    return walk(params, stacked=False)
+    return quantize_tree(params, _LLAMA_QUANT_KEYS,
+                         frozenset({"layers"}))
+
+
+def quantize_moe(params: dict) -> dict:
+    """Quantize a MoE parameter tree: attention + head like Llama, but
+    the stacked expert FFN weights are ``[L, E, in, out]`` and need
+    per-(layer, EXPERT, channel) scales — ``batch_dims=2`` — so
+    ``jax.vmap`` over the expert axis maps values and scales in
+    lockstep (a Llama-style [L, 1, 1, out] scale would both break the
+    vmap axis sizes and silently share one scale across experts).  The
+    f32 router stays full precision (routing is precision-critical)."""
+    return quantize_tree(
+        params, _LLAMA_QUANT_KEYS, frozenset({"layers"}),
+        stacked_batch_dims={"w_gate": 2, "w_up": 2, "w_down": 2})
+
+
+def quantize_t5(params: dict) -> dict:
+    """Quantize a T5 encoder-decoder tree; drops into ``t5_encode`` /
+    ``t5_greedy_generate`` unchanged — including the precomputed
+    cross-K/V path (``enc_out @ ck`` traces through the QTensor
+    overload like every other weight use)."""
+    return quantize_tree(params, _T5_QUANT_KEYS,
+                         frozenset({"encoder", "decoder"}))
 
 
 def tree_nbytes(tree) -> int:

@@ -109,19 +109,24 @@ def _row_step(params: dict, tokens: jax.Array, cache: dict,
 
 @functools.lru_cache(maxsize=32)
 def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
-                stride: int, top_k: int = 0):
+                stride: int, top_k: int = 0, sampling: bool = False):
     """Jitted engine pieces, cached per static signature.  ``top_k``
     is the engine-wide truncation for sampled slots (static: per-slot
     k would be shape-dynamic); per-REQUEST temperature rides a [B]
-    vector — 0 means greedy for that slot."""
+    vector — 0 means greedy for that slot.  ``sampling`` is STATIC:
+    a greedy-only engine traces pure argmax steps — temps is a
+    runtime input, so XLA could never dead-code the full-vocab
+    categorical draw out of the hot scan on its own."""
 
     def _pick(logits, temps, k_):
         """Per-slot token selection: greedy where temps == 0, else the
         shared :func:`decode._sample_token` draw (temperature-scaled,
         top-k-truncated) — the truncation math exists exactly once;
         only the per-row greedy/sampled blend is this engine's."""
-        from kubegpu_tpu.models.decode import _sample_token
         greedy = jnp.argmax(logits, axis=-1)
+        if not sampling:
+            return greedy
+        from kubegpu_tpu.models.decode import _sample_token
         sampled = _sample_token(logits, k_, temps[:, None],
                                 jnp.float32(1.0), top_k, nucleus=False)
         return jnp.where(temps > 0, sampled, greedy)
@@ -220,10 +225,11 @@ class ContinuousBatcher:
     def __init__(self, params: dict, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: int | None = None, stride: int = 16,
                  prompt_buckets: tuple[int, ...] = (128, 512, 1024),
-                 top_k: int = 0, seed: int = 0):
+                 sampling: bool = False, top_k: int = 0, seed: int = 0):
         if not 0 <= top_k <= cfg.vocab_size:
             raise ValueError(
                 f"top_k {top_k} not in [0, vocab_size={cfg.vocab_size}]")
+        self.sampling = sampling
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -233,7 +239,7 @@ class ContinuousBatcher:
         if self.prompt_buckets[-1] >= self.max_len:
             raise ValueError("largest prompt bucket must be < max_len")
         self._fns = _engine_fns(cfg, n_slots, self.max_len, stride,
-                                top_k)
+                                top_k, sampling)
         self.cache = init_kv_cache(cfg, n_slots, self.max_len)
         self.tokens = jnp.zeros((n_slots,), jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
@@ -274,6 +280,11 @@ class ContinuousBatcher:
         if temperature < 0:
             raise ValueError(
                 f"temperature must be >= 0, got {temperature}")
+        if temperature > 0 and not self.sampling:
+            raise ValueError(
+                "temperature > 0 needs a sampling-enabled engine "
+                "(ContinuousBatcher(..., sampling=True)) — greedy-only "
+                "engines compile argmax-only decode steps")
         prompt = jnp.asarray(prompt, jnp.int32)
         t = int(prompt.shape[0])
         if t < 1:

@@ -352,6 +352,11 @@ def t5_init_decode_state(params: dict, enc_out: jax.Array,
     nd = cfg.n_dec_layers
 
     def project(w):   # [L, D_model, H*hd] over enc_out [B, S, D_model]
+        if hasattr(w, "dequantize"):
+            # int8 weights (quantize_t5): einsum has no QTensor
+            # overload — dequantize once here, at state init, not in
+            # the per-step decode path
+            w = w.dequantize(enc_out.dtype)
         y = jnp.einsum("bsd,ldh->lbsh", enc_out, w)
         return y.reshape(nd, b, enc_out.shape[1], cfg.n_heads, hd) \
                 .transpose(0, 1, 3, 2, 4)      # [L, B, H, S_enc, hd]

@@ -131,3 +131,69 @@ class TestStackedQTensor:
         err = jnp.max(jnp.abs(d - params["layers"]["wq"])
                       / jnp.squeeze(q.scale, -2)[:, None, :])
         assert float(err) <= 0.5 + 1e-6
+
+
+class TestQuantizedT5:
+    def test_t5_quantized_serving(self):
+        """quantize_t5 drops into encode + cached greedy decode
+        unchanged — including the precomputed cross-K/V path — with
+        halved matmul-weight bytes and bounded logit error."""
+        from kubegpu_tpu.models.quant import quantize_t5, tree_nbytes
+        from kubegpu_tpu.models.t5 import (
+            T5Config,
+            t5_encode,
+            t5_greedy_generate,
+            t5_init,
+        )
+        cfg = T5Config.tiny()
+        params = t5_init(jax.random.PRNGKey(3), cfg)
+        qparams = quantize_t5(params)
+        assert tree_nbytes(qparams) < 0.62 * tree_nbytes(params)
+        enc = jnp.asarray(
+            np.arange(2 * 6).reshape(2, 6) % cfg.vocab_size, jnp.int32)
+        full = t5_encode(params, enc, cfg)
+        quant = t5_encode(qparams, enc, cfg)
+        # int8 weight error compounds per layer but stays small
+        assert float(jnp.mean(jnp.abs(full - quant))) < 0.1 * float(
+            jnp.mean(jnp.abs(full)) + 1e-6)
+        toks = t5_greedy_generate(qparams, enc, 5, cfg)
+        assert toks.shape == (2, 5)
+        assert bool(jnp.all((toks >= 0) & (toks < cfg.vocab_size)))
+
+
+class TestQuantizedMoE:
+    def test_moe_quantized_serving(self):
+        """quantize_moe: expert weights carry per-(layer, expert,
+        channel) scales so the vmap'd expert matmuls map values and
+        scales in lockstep; routed decode runs quantized and the f32
+        router stays untouched."""
+        from kubegpu_tpu.models.moe import (
+            MoEConfig,
+            moe_forward,
+            moe_greedy_generate,
+            moe_init,
+        )
+        from kubegpu_tpu.models.quant import (
+            QTensor,
+            quantize_moe,
+            tree_nbytes,
+        )
+        cfg = MoEConfig.tiny()
+        params = moe_init(jax.random.PRNGKey(4), cfg)
+        qparams = quantize_moe(params)
+        assert tree_nbytes(qparams) < 0.62 * tree_nbytes(params)
+        wg = qparams["layers"]["w_gate"]
+        assert isinstance(wg, QTensor)
+        # per-(layer, EXPERT, channel) scales: expert axis NOT reduced
+        assert wg.scale.shape[:2] == wg.values.shape[:2]
+        assert qparams["layers"]["w_router"].dtype == jnp.float32
+        toks = jnp.asarray(
+            np.arange(2 * 6).reshape(2, 6) % cfg.base.vocab_size,
+            jnp.int32)
+        full, _ = moe_forward(params, toks, cfg)
+        quant, _ = moe_forward(qparams, toks, cfg)
+        assert float(jnp.mean(jnp.abs(full - quant))) < 0.1 * float(
+            jnp.mean(jnp.abs(full)) + 1e-6)
+        gen = moe_greedy_generate(qparams, toks, 4, cfg,
+                                  max_len=cfg.base.max_seq_len)
+        assert gen.shape == (2, 4)

@@ -99,8 +99,8 @@ class TestContinuousBatcher:
 
         def run(seed):
             eng = ContinuousBatcher(params, cfg, n_slots=2, stride=4,
-                                    prompt_buckets=(8,), top_k=8,
-                                    seed=seed)
+                                    prompt_buckets=(8,), sampling=True,
+                                    top_k=8, seed=seed)
             rg = eng.submit(p_g, 8)                     # greedy
             rs = eng.submit(p_s, 8, temperature=1.0)    # sampled
             done = {r.rid: r.tokens for r in eng.drain()}
@@ -124,6 +124,11 @@ class TestContinuousBatcher:
                                 prompt_buckets=(8,))
         with pytest.raises(ValueError, match="temperature"):
             eng.submit([1, 2], 2, temperature=-0.5)
+        with pytest.raises(ValueError, match="sampling-enabled"):
+            eng.submit([1, 2], 2, temperature=1.0)  # greedy-only engine
+        with pytest.raises(ValueError, match="top_k"):
+            ContinuousBatcher(params, cfg, n_slots=1,
+                              prompt_buckets=(8,), top_k=-1)
 
     def test_single_token_request(self, tiny):
         """max_new_tokens=1: the prefill's argmax IS the answer; the
