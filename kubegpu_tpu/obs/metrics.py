@@ -127,3 +127,37 @@ class MetricsRegistry:
 
 
 global_registry = MetricsRegistry()
+
+
+def serve_prometheus(registry: MetricsRegistry, host: str = "127.0.0.1",
+                     port: int = 0):
+    """Standalone Prometheus scrape endpoint (GET /metrics) for daemon
+    processes that have no other HTTP server — the extender webhook
+    integrates the same surface into its own dispatch; this is the
+    scheduler daemon's.  ``host`` matters in a container netns (a
+    loopback-only bind is unreachable from an off-host scraper).
+    Returns the started ThreadingHTTPServer; call ``shutdown()`` +
+    ``server_close()`` to stop."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.split("?", 1)[0] != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = registry.to_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv

@@ -66,6 +66,13 @@ def main(argv: list[str] | None = None) -> int:
                     "arrive (events wake the loop immediately)")
     ap.add_argument("--gang-grace", type=float, default=30.0,
                     help="incomplete-gang head-of-line grace (seconds)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve Prometheus text at GET /metrics on this "
+                    "port (0 = disabled) — the webhook's obs surface, "
+                    "for the daemon deployment shape")
+    ap.add_argument("--metrics-host", default="127.0.0.1",
+                    help="bind address for /metrics (use 0.0.0.0 in a "
+                    "container netns so an off-host scraper can reach it)")
     args = ap.parse_args(argv)
 
     backoff = 0.2
@@ -79,6 +86,14 @@ def main(argv: list[str] | None = None) -> int:
             time.sleep(backoff)
             backoff = min(backoff * 2, 10.0)
     print(f"scheduler: connected to {args.apiserver}", flush=True)
+
+    metrics_srv = None
+    if args.metrics_port:
+        from kubegpu_tpu.obs.metrics import serve_prometheus
+        metrics_srv = serve_prometheus(sched.metrics, args.metrics_host,
+                                       args.metrics_port)
+        print(f"scheduler: /metrics on port "
+              f"{metrics_srv.server_address[1]}", flush=True)
 
     # Event-driven wakeup: pod/node churn triggers an immediate pass
     # (the recovery controller watches through the same cache and marks
@@ -129,6 +144,9 @@ def main(argv: list[str] | None = None) -> int:
         recovery.close()
         cache.close()
         api.close()
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+            metrics_srv.server_close()
     return 0
 
 

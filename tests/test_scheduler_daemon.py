@@ -33,7 +33,9 @@ def _stop(*procs: subprocess.Popen) -> None:
             p.kill()
 
 
-def _wait(cond, timeout=40.0, interval=0.1, what="condition"):
+def _wait(cond, timeout=90.0, interval=0.1, what="condition"):
+    # generous: three cold python processes importing jax under a
+    # loaded CI machine can take tens of seconds to come up
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
@@ -85,6 +87,38 @@ class TestDaemonBuilder:
             recovery.close()
             cache.close()
             client.close()
+            srv.close()
+
+
+class TestDaemonMetrics:
+    @pytest.mark.slow
+    def test_metrics_endpoint_over_http(self):
+        """--metrics-port serves the Prometheus surface from the
+        scheduler daemon process; after a pod schedules, the
+        schedule-latency summary must be present."""
+        import urllib.request
+
+        from kubegpu_tpu.crishim.agent import NodeAgent
+        from kubegpu_tpu.crishim.runtime import FakeRuntime
+        from kubegpu_tpu.tpuplugin import MockBackend
+
+        api = FakeApiServer()
+        srv = ApiServerHTTP(api).start()
+        agent = NodeAgent(api, MockBackend("v4-8"), FakeRuntime())
+        agent.register()
+        mport = _free_port()
+        sch = _spawn("kubegpu_tpu.scheduler.daemon",
+                     "--apiserver", srv.address, "--tick", "0.2",
+                     "--metrics-port", str(mport))
+        try:
+            api.create("Pod", tpu_pod("m", chips=1, command=["x"]))
+            _wait(lambda: api.get("Pod", "m").status.phase
+                  == PodPhase.SCHEDULED, what="pod scheduled")
+            _wait(lambda: b"schedule_latency_ms" in urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=5).read(),
+                timeout=15, what="metrics endpoint")
+        finally:
+            _stop(sch)
             srv.close()
 
 
