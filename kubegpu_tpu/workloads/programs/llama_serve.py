@@ -12,11 +12,18 @@ tiny model.  SERVE_CONFIG overrides: auto | tiny | bench.
 
 Env knobs:
   SERVE_CONFIG   auto (default) | tiny | bench
-  SERVE_BATCH    sequences (default 4 tiny / 32 bench)
+  SERVE_MODE     static (default) | continuous — continuous runs the
+                 arrival-driven ContinuousBatcher (models/serve.py):
+                 SERVE_BATCH slots, SERVE_REQS sustained requests of
+                 SERVE_STEPS tokens each, reporting steady-state
+                 engine tok/s + occupancy
+  SERVE_BATCH    sequences/slots (default 4 tiny / 32 bench)
   SERVE_PROMPT   prompt length (default 128 tiny / 1024 bench)
-  SERVE_STEPS    decode steps (default 32 tiny / 128 bench)
+  SERVE_STEPS    decode steps per sequence (default 32 tiny / 128 bench)
+  SERVE_REQS     continuous mode: total requests (default 3x slots)
   SERVE_INT8     "1" quantizes weights AND KV cache
-                 (default: 0 tiny, 1 bench)
+                 (default: 0 tiny, 1 bench; continuous mode uses int8
+                 weights only — its cache is bf16)
 
 The decode throughput metric subtracts a separately-timed prefill of
 the same configuration (the advisor's r2 finding: dividing by an
@@ -66,10 +73,13 @@ def main() -> int:
         int8 = os.environ.get("SERVE_INT8", "0") == "1"
         cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, dtype="float32",
                                max_seq_len=prompt_t + steps)
-    max_len = prompt_t + steps
     params = llama_init(jax.random.PRNGKey(0), cfg)
     if int8:
         params = quantize_llama(params)
+    if os.environ.get("SERVE_MODE", "static") == "continuous":
+        return _serve_continuous(env, cfg, params, batch, prompt_t,
+                                 steps, int8)
+    max_len = prompt_t + steps
     prompt = jnp.asarray(
         np.arange(batch * prompt_t).reshape(batch, prompt_t)
         % cfg.vocab_size, jnp.int32)
@@ -127,6 +137,63 @@ def main() -> int:
         }))
     if not ok:
         print("FAIL: generated token out of range", file=sys.stderr)
+        return 3
+    return 0
+
+
+def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
+                      int8) -> int:
+    """Arrival-driven serving as a schedulable workload: saturate a
+    ContinuousBatcher with SERVE_REQS requests and report steady-state
+    engine throughput + occupancy as harvestable metric lines."""
+    import jax
+    import numpy as np
+
+    from kubegpu_tpu.models.serve import ContinuousBatcher
+
+    stride = max(4, min(16, steps))
+    n_reqs = int(os.environ.get("SERVE_REQS", str(3 * n_slots)))
+    max_len = prompt_t + steps + stride + 8
+    base = np.arange(prompt_t) % cfg.vocab_size
+    # warm the executables in a THROWAWAY engine (same static
+    # signature → shared compile cache): occupancy is a lifetime
+    # ratio, and a warm-up drain inside the measured engine would
+    # dilute the published gauge with one request's worth of
+    # nearly-empty slot-steps
+    warm = ContinuousBatcher(params, cfg, n_slots=n_slots,
+                             max_len=max_len, stride=stride,
+                             prompt_buckets=(prompt_t,))
+    warm.submit(list(base), steps)
+    warm.drain()
+    eng = ContinuousBatcher(params, cfg, n_slots=n_slots,
+                            max_len=max_len, stride=stride,
+                            prompt_buckets=(prompt_t,))
+    t0 = time.perf_counter()
+    for i in range(n_reqs):
+        eng.submit(list((base + i) % cfg.vocab_size), steps)
+    done = eng.drain()
+    elapsed = time.perf_counter() - t0
+    total = sum(len(r.tokens) for r in done)
+    ok = len(done) == n_reqs and all(
+        0 <= t < cfg.vocab_size for r in done for t in r.tokens)
+    if env.worker_id == 0:
+        common = {
+            "unit": "tokens/s", "mode": "continuous",
+            "slots": n_slots, "prompt": prompt_t, "steps": steps,
+            "requests": n_reqs, "int8": int8,
+            "devices": jax.device_count(),
+        }
+        print(json.dumps({
+            "metric": "serve_engine_tokens_per_s",
+            "value": round(total / elapsed, 1), **common,
+        }))
+        print(json.dumps({
+            "metric": "serve_engine_occupancy",
+            "value": round(eng.occupancy, 4), "unit": "fraction",
+        }))
+    if not ok:
+        print("FAIL: continuous engine dropped or corrupted requests",
+              file=sys.stderr)
         return 3
     return 0
 

@@ -151,3 +151,26 @@ class TestServingWorkload:
             assert snap["gauges"]["workload_serve_decode_tokens_per_s"] > 0
         finally:
             cl.close()
+
+    def test_continuous_mode_metric_lands_in_registry(self):
+        """SERVE_MODE=continuous runs the arrival-driven engine inside
+        the scheduled pod and harvests its steady-state throughput +
+        occupancy."""
+        pods, slice_types = specs.llama_serving()
+        for p in pods:
+            p.spec.containers[0].env.update({
+                "SERVE_MODE": "continuous", "SERVE_STEPS": "6",
+                "SERVE_REQS": "6"})
+        cl = SimCluster(slice_types, real_processes=True,
+                        extra_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            cl.submit(*pods)
+            codes = cl.run_to_completion(timeout_s=300)
+            assert codes == {"llama-serve": 0}, (
+                codes,
+                cl.api.get("Pod", "llama-serve").status.message)
+            snap = cl.metrics.snapshot()
+            assert snap["gauges"]["workload_serve_engine_tokens_per_s"] > 0
+            assert 0 < snap["gauges"]["workload_serve_engine_occupancy"] <= 1
+        finally:
+            cl.close()
