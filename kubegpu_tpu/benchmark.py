@@ -429,18 +429,14 @@ def _families_bench(cfg, params, on_tpu) -> dict:
     # engine end-to-end drain under sustained arrivals (the queue never
     # empties until the tail): raw wall time includes one host round
     # trip per tick — subtracted like every other row's end fetch
-    warm = ContinuousBatcher(qparams, cfg, n_slots=cb_slots,
-                             max_len=cb_len, stride=cb_stride,
-                             prompt_buckets=(cb_prompt,))
-    warm.submit(list(cb_p), cb_new)
-    warm.drain()
     rtt = _fetch_rtt_s(jnp.zeros((4,)))
     eng = ContinuousBatcher(qparams, cfg, n_slots=cb_slots,
                             max_len=cb_len, stride=cb_stride,
                             prompt_buckets=(cb_prompt,))
+    eng.warmup()   # state-free: compiles every wave size + the block
     t0 = time.perf_counter()
     for i in range(cb_reqs):
-        eng.submit(list((cb_p + i) % cfg.vocab_size), cb_new)
+        eng.submit((cb_p + i) % cfg.vocab_size, cb_new)
     done = eng.drain()
     cb_elapsed = time.perf_counter() - t0
     cb_ticks = eng.slot_steps // (cb_stride * cb_slots)

@@ -23,13 +23,19 @@ item #7) is the SLOT engine:
   even locally it serializes dispatch.  Admission/retirement granularity
   is the stride.
 
-Correctness contract: slots are independent batch rows, so a request's
-tokens are bit-identical (in f32) to a solo ``greedy_generate`` of the
-same prompt — asserted in tests with staggered arrivals.  Right-pad
-garbage is never attended: pad rows sit at positions ≥ the row's
-true length, the per-row mask hides ``k_pos > q_pos``, and generation
-overwrites each row before its position becomes visible (the same
-overwrite-before-attend invariant the speculative verifier relies on).
+Correctness contract: slots are independent batch rows — a request's
+attention/FFN math never mixes with its neighbors'.  Tokens are
+bit-identical to a solo ``greedy_generate`` at the tested
+configurations (f32, small slot counts, asserted with staggered
+arrivals); at other batch sizes XLA may choose different reduction
+orders, which can flip a near-degenerate argmax tie (observed once at
+n_slots=4 on an untrained f32 model — the same chunked-vs-stepwise
+caveat spec decoding documents).  Right-pad garbage is never
+attended: pad rows sit at positions ≥ the row's true length, the
+per-row mask hides ``k_pos > q_pos``, and generation overwrites each
+row before its position becomes visible (the same
+overwrite-before-attend invariant the speculative verifier relies
+on).
 """
 
 from __future__ import annotations
@@ -158,42 +164,52 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
         return block, tokens, pos, cache
 
     @jax.jit
-    def prefill_one(params, padded_prompt, true_len, temp, base_key,
-                    rid):
-        """Batch-1 prefill on a right-padded prompt (the padded shape
-        keys the compile cache — one executable per bucket).  Returns
-        (first generated token [1], batch-1 cache); the first token is
-        picked at the TRUE last prompt position (pad logits ignored),
-        greedy or sampled per the request's temperature.  The rid
+    def prefill_wave(params, padded_prompts, true_lens, temps_w,
+                     base_key, rid0):
+        """Batch-k prefill on right-padded prompts [k, bucket] (the
+        padded SHAPE — both k and bucket — keys the compile cache).
+        Returns (first tokens [k], batch-k cache); each row's first
+        token is picked at ITS true last prompt position (pad logits
+        ignored), greedy or sampled per-row.  The wave's first rid
         folds into the key inside the jit (separate domain from the
-        block keys via the leading 1)."""
+        block keys via the leading 1); rows draw independently from
+        the one key via the batched categorical."""
         from kubegpu_tpu.models.decode import _forward_with_cache
-        cache1 = init_kv_cache(cfg, 1, max_len)
-        logits, cache1 = _forward_with_cache(
-            params, padded_prompt, cache1, jnp.int32(0), cfg)
-        last = lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
-                                        keepdims=False)     # [1, V]
-        key = jax.random.fold_in(jax.random.fold_in(base_key, 1), rid)
-        return _pick(last, temp[None], key).astype(jnp.int32), cache1
+        k = padded_prompts.shape[0]
+        cache_w = init_kv_cache(cfg, k, max_len)
+        logits, cache_w = _forward_with_cache(
+            params, padded_prompts, cache_w, jnp.int32(0), cfg)
+        last = jnp.take_along_axis(
+            logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+        key = jax.random.fold_in(jax.random.fold_in(base_key, 1), rid0)
+        return _pick(last, temps_w, key).astype(jnp.int32), cache_w
 
-    @jax.jit
-    def adopt_slot(cache, cache1, slot, first, plen, temp,
-                   first_toks, tokens, pos, temps):
-        """Admit in ONE dispatch: scatter a batch-1 cache into slot row
-        ``slot`` and update every per-slot device vector.  (A handful
-        of eager ``.at[].set`` ops per admission each cost a dispatch —
-        under the tunnel that overhead rivaled the decode itself.)"""
-        cache = jax.tree.map(
-            lambda big, one: lax.dynamic_update_slice(
-                big, one.astype(big.dtype), (0, slot, 0, 0, 0)),
-            cache, cache1)
-        first_toks = lax.dynamic_update_slice(first_toks, first, (slot,))
-        tokens = lax.dynamic_update_slice(tokens, first, (slot,))
-        pos = lax.dynamic_update_slice(pos, plen[None], (slot,))
-        temps = lax.dynamic_update_slice(temps, temp[None], (slot,))
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def adopt_wave(cache, cache_w, slots, firsts, plens, temps_w,
+                   first_toks, tokens, pos, temps, k):
+        """Admit a whole wave in ONE dispatch: scatter the batch-k
+        cache's rows into (possibly non-contiguous) slots and update
+        every per-slot device vector.  (Eager ``.at[].set`` ops per
+        admission each cost a dispatch — under the tunnel that
+        overhead rivaled the decode itself.)"""
+        for i in range(k):   # k is static: unrolled slice-updates
+            cache = jax.tree.map(
+                lambda big, w: lax.dynamic_update_slice(
+                    big, lax.dynamic_slice_in_dim(
+                        w, i, 1, axis=1).astype(big.dtype),
+                    (0, slots[i], 0, 0, 0)),
+                cache, cache_w)
+            first_toks = lax.dynamic_update_slice(
+                first_toks, firsts[i:i + 1], (slots[i],))
+            tokens = lax.dynamic_update_slice(
+                tokens, firsts[i:i + 1], (slots[i],))
+            pos = lax.dynamic_update_slice(
+                pos, plens[i:i + 1], (slots[i],))
+            temps = lax.dynamic_update_slice(
+                temps, temps_w[i:i + 1], (slots[i],))
         return cache, first_toks, tokens, pos, temps
 
-    return decode_block, prefill_one, adopt_slot
+    return decode_block, prefill_wave, adopt_wave
 
 
 # ---------------------------------------------------------------------------
@@ -225,11 +241,22 @@ class ContinuousBatcher:
     def __init__(self, params: dict, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: int | None = None, stride: int = 16,
                  prompt_buckets: tuple[int, ...] = (128, 512, 1024),
-                 sampling: bool = False, top_k: int = 0, seed: int = 0):
+                 sampling: bool = False, top_k: int = 0, seed: int = 0,
+                 max_wave: int = 1):
         if not 0 <= top_k <= cfg.vocab_size:
             raise ValueError(
                 f"top_k {top_k} not in [0, vocab_size={cfg.vocab_size}]")
         self.sampling = sampling
+        # Wave-size cap, DEFAULT 1.  Batched admission (k requests in
+        # one [k, bucket] prefill + one adopt) is implemented and
+        # parity-tested, but on-chip A/B runs were inconclusive: the
+        # tunnel's throughput swung 5x between measurement windows,
+        # and within one window k=1 was never slower (per-request
+        # prefill cost measured flat across k — prefill is
+        # compute-bound at these shapes — while each wave holds a
+        # [k, max_len] cache transient alive).  Raise only with a
+        # trustworthy measurement setup.
+        self.max_wave = max(1, max_wave)
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -267,6 +294,38 @@ class ContinuousBatcher:
         #                              prefill-produced first token)
         self._decode_tokens = 0      # tokens produced BY decode steps
         self.slot_steps = 0          # decode slot-steps spent
+
+    def warmup(self) -> None:
+        """Compile every executable this engine can hit — the decode
+        block and each power-of-two wave size per prompt bucket —
+        WITHOUT touching engine state (all calls are functional and
+        their outputs are discarded; counters stay at zero).  Benches
+        and serving pods call this before the timed window: the first
+        full-slot wave otherwise compiles a [n_slots, bucket] prefill
+        mid-measurement (observed eating ~95% of a flagship run)."""
+        decode_block, prefill_wave, adopt_wave = self._fns
+        outs = []
+        for bucket in self.prompt_buckets:
+            k = 1
+            while k <= min(self.n_slots, self.max_wave):
+                padded = jnp.zeros((k, bucket), jnp.int32)
+                lens = jnp.ones((k,), jnp.int32)
+                temps = jnp.zeros((k,), jnp.float32)
+                firsts, cache_w = prefill_wave(
+                    self.params, padded, lens, temps, self._base_key,
+                    jnp.int32(0))
+                outs.append(adopt_wave(
+                    self.cache, cache_w,
+                    jnp.arange(k, dtype=jnp.int32), firsts, lens,
+                    temps, self.first_toks, self.tokens, self.pos,
+                    self.temps, k)[1])
+                k *= 2
+        outs.append(decode_block(
+            self.params, self.cache, self.tokens, self.pos,
+            jnp.asarray(self.active), self.temps, self._base_key,
+            jnp.int32(0))[0])
+        for o in outs:   # block until every compile finished
+            np.asarray(o)
 
     # -- submission -----------------------------------------------------
 
@@ -311,30 +370,51 @@ class ContinuousBatcher:
     # -- the engine tick ------------------------------------------------
 
     def _admit(self) -> None:
-        decode_block, prefill_one, adopt_slot = self._fns
+        decode_block, prefill_wave, adopt_wave = self._fns
         free = [s for s in range(self.n_slots)
                 if s not in self.slot_req]
         while free and self.queue:
-            slot = free.pop(0)
-            req, padded = self.queue.popleft()
-            first, cache1 = prefill_one(
-                self.params, padded, req.prompt_len,
-                jnp.float32(req.temperature), self._base_key,
-                jnp.int32(req.rid))
-            # two dispatches per admission, zero host fetches: the
-            # first token's value reaches req.tokens at the next tick's
-            # fused fetch
+            # WAVE admission: consecutive queue-front requests sharing
+            # one prompt bucket prefill as a single [k, bucket] batch
+            # (one prefill + one adopt dispatch instead of 2k, and the
+            # batched prompt matmuls beat k batch-1 passes).  k rounds
+            # down to a power of two so the per-(k, bucket) executable
+            # count stays at log2(n_slots) per bucket; FIFO order is
+            # preserved — a different-bucket request at the front just
+            # bounds this wave, never gets jumped.
+            bucket = self.queue[0][1].shape[1]
+            n_same = 1
+            for r, p in list(self.queue)[1:min(len(self.queue),
+                                               len(free))]:
+                if p.shape[1] != bucket:
+                    break
+                n_same += 1
+            k = 1
+            while k * 2 <= min(n_same, len(free), self.max_wave):
+                k *= 2
+            wave = [self.queue.popleft() for _ in range(k)]
+            slots = [free.pop(0) for _ in range(k)]
+            padded = jnp.concatenate([p for _, p in wave], axis=0)
+            true_lens = jnp.asarray(
+                [r.prompt_len for r, _ in wave], jnp.int32)
+            temps_w = jnp.asarray(
+                [r.temperature for r, _ in wave], jnp.float32)
+            firsts, cache_w = prefill_wave(
+                self.params, padded, true_lens, temps_w,
+                self._base_key, jnp.int32(wave[0][0].rid))
+            # two dispatches per WAVE, zero host fetches: first-token
+            # values reach req.tokens at the next tick's fused fetch
             (self.cache, self.first_toks, self.tokens,
-             self.pos, self.temps) = adopt_slot(
-                self.cache, cache1, jnp.int32(slot), first,
-                jnp.int32(req.prompt_len),
-                jnp.float32(req.temperature), self.first_toks,
-                self.tokens, self.pos, self.temps)
-            self.active[slot] = req.max_new_tokens > 1
-            self.slot_req[slot] = req
-            self.emitted_tokens += 1
-            if req.max_new_tokens <= 1:
-                req.done = True
+             self.pos, self.temps) = adopt_wave(
+                self.cache, cache_w, jnp.asarray(slots, jnp.int32),
+                firsts, true_lens, temps_w, self.first_toks,
+                self.tokens, self.pos, self.temps, k)
+            for slot, (req, _) in zip(slots, wave):
+                self.active[slot] = req.max_new_tokens > 1
+                self.slot_req[slot] = req
+                self.emitted_tokens += 1
+                if req.max_new_tokens <= 1:
+                    req.done = True
 
     def step(self) -> list[_Request]:
         """One engine tick: collect the previous tick's in-flight block,

@@ -88,6 +88,28 @@ class TestContinuousBatcher:
             ContinuousBatcher(params, cfg, n_slots=1,
                               prompt_buckets=(64,))
 
+    def test_wave_admission_bit_parity(self, tiny):
+        """max_wave=2: two same-bucket requests admitted as ONE [2,
+        bucket] prefill wave (non-contiguous adopt, heterogeneous true
+        lengths) must still decode exactly like solo greedy."""
+        cfg, params = tiny
+        eng = ContinuousBatcher(params, cfg, n_slots=2, stride=4,
+                                prompt_buckets=(8, 16), max_wave=2)
+        eng.warmup()
+        prompts = [
+            ([(i * 5 + 1) % cfg.vocab_size for i in range(4)], 8),
+            ([(i * 7 + 2) % cfg.vocab_size for i in range(6)], 6),
+            ([(i * 3 + 5) % cfg.vocab_size for i in range(11)], 7),
+            ([(i * 9 + 4) % cfg.vocab_size for i in range(5)], 9),
+        ]
+        rids = {}
+        for p, n in prompts:   # first two form a k=2 wave; the third
+            rids[eng.submit(p, n)] = (p, n)   # (bucket 16) waits
+        done = {r.rid: r.tokens for r in eng.drain()}
+        assert set(done) == set(rids)
+        for rid, (p, n) in rids.items():
+            assert done[rid] == solo(params, p, n, cfg), rid
+
     def test_sampled_and_greedy_coexist(self, tiny):
         """A sampled request (temperature > 0) in the batch must not
         perturb a greedy neighbor's tokens — the per-slot temperature
