@@ -729,29 +729,50 @@ class GangAllocator:
         # against the cross-slice incumbent also loses (strict > in
         # find_assignment).
         floor = incumbent if incumbent is not None else float("-inf")
+        # `rect_scored` settles connected-fallback eligibility: it flips
+        # the moment ANY rectangular placement passes _score_placement.
+        # While it is still False the loop keeps scoring BELOW the
+        # incumbent floor (candidates there can't beat the incumbent —
+        # score <= bound <= floor — so this only settles eligibility,
+        # never changes the winner), which makes eligibility a pure
+        # function of (slice occupancy, request), independent of the
+        # cross-slice incumbent and hence of slice iteration order
+        # (ADVICE r3: the r3 `not ranked` gate silently declared a slice
+        # unschedulable when rectangles enumerated but every ordering
+        # failed the host-chunking filter).
+        rect_scored = False
         for frag, _, pl in ranked:
             bound = 10.0 * (self.locality_weight
                             + self.frag_weight * frag
                             + self.fill_weight * fill)
-            if bound <= floor or (best is not None
-                                  and bound <= best.score):
+            if best is not None and bound <= best.score:
                 break
+            if bound <= floor:
+                if rect_scored:
+                    break
+                # Below the incumbent floor a candidate can't win
+                # (score <= bound <= floor, strict > cross-slice), so
+                # settle eligibility with the cheap host-chunking probe
+                # instead of the full ordering-locality search — the
+                # losing-slice hot path stays near its r3 cost.
+                if self._rect_feasible(st, pl, req, axes):
+                    rect_scored = True
+                    break
+                continue
             cand = self._score_placement(st, pl, req, axes, blocked, fill,
                                          frag=frag)
-            if cand and (best is None or cand.score > best.score):
-                best = cand
-        if not ranked:
-            # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) fall back
-            # to a connected free set — the reference's group allocator had
-            # the same flexibility since groups weren't geometric.
-            # Eligibility is `not ranked` — a pure function of (slice
-            # occupancy, request), NEVER of the cross-slice incumbent
-            # (r3 review, thrice-revised: any floor-dependent gate makes
-            # the returned assignment depend on slice iteration order).
-            # The theoretical corner this forgoes — rectangular
-            # placements exist but every candidate ordering fails the
-            # host-chunking filter — is fuzz-covered as unplaceable-by-
-            # rectangles, and treating it as such keeps determinism.
+            if cand:
+                rect_scored = True
+                if best is None or cand.score > best.score:
+                    best = cand
+                if bound <= floor:
+                    break
+        if not rect_scored:   # also covers `not ranked` (loop never ran)
+            # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) — or
+            # slices where every rectangular ordering fails the
+            # host-chunking filter — fall back to a connected free set;
+            # the reference's group allocator had the same flexibility
+            # since groups weren't geometric.
             cand = self._connected_candidate(st, req, blocked, axes,
                                              mask=occ_mask)
             if cand is not None:
@@ -829,6 +850,21 @@ class GangAllocator:
             return _Candidate(slice_state=st, placement=pl, order=order,
                               locality=loc, score=score)
         return None
+
+    def _rect_feasible(self, st: SliceState, pl: Placement,
+                       req: GangRequest, axes: dict[str, int]) -> bool:
+        """Does ANY candidate ordering of ``pl`` chunk host-locally?
+        Exactly `_score_placement(...) is not None` — same order set,
+        same filter — but lazy and without ``evaluate_order``, so the
+        below-floor eligibility probe costs chunk checks, not the
+        locality search."""
+        c = req.chips_per_pod
+        ring_span = list(axes.values())[-1] if axes else None
+        for o in candidate_orders(pl):
+            if _chunks_host_local(st.topo, o, c):
+                return True
+        return any(_chunks_host_local(st.topo, o, c)
+                   for o in _block_orders(st.topo, pl, ring_span))
 
     def _score_placement(self, st: SliceState, pl: Placement,
                          req: GangRequest, axes: dict[str, int],

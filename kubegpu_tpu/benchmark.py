@@ -740,17 +740,46 @@ def run_wire_bench(n_pods: int = 40, slice_type: str = "v5e-64") -> dict:
         [_sys.executable, "-m", "kubegpu_tpu.scheduler.daemon",
          "--apiserver", srv.address, "--tick", "0.5"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    # Reader threads drain BOTH pipes for the daemon's whole life
+    # (ADVICE r3: a blocking readline here could hang past the deadline
+    # if the daemon filled the 64KB stderr pipe without ever printing
+    # the readiness line).
+    ready = threading.Event()
+    out_lines: list = []
+    err_lines: list = []
+
+    def _pump(stream, sink, needle=None):
+        for line in stream:
+            sink.append(line)
+            if needle and line.startswith(needle):
+                ready.set()
+
+    threading.Thread(target=_pump,
+                     args=(proc.stdout, out_lines, "scheduler: connected"),
+                     daemon=True).start()
+    err_pump = threading.Thread(target=_pump, args=(proc.stderr, err_lines),
+                                daemon=True)
+    err_pump.start()
+
+    def _stderr_tail() -> str:
+        # Let the pump reach EOF so a crash traceback is fully captured
+        # before we format the error (racing it can report '' instead).
+        err_pump.join(timeout=2.0)
+        return "".join(err_lines)[-500:]
+
     lat_ms = []
     try:
         deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if line.startswith("scheduler: connected"):
-                break
-            if not line or proc.poll() is not None:   # EOF = daemon died
+        while not ready.is_set():
+            if proc.poll() is not None:
                 raise RuntimeError(
                     "scheduler daemon died at startup "
-                    f"(rc={proc.poll()}): {proc.stderr.read()[-500:]}")
+                    f"(rc={proc.poll()}): {_stderr_tail()}")
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "scheduler daemon never printed readiness within 30s; "
+                    f"stderr: {''.join(err_lines)[-500:]}")
+            ready.wait(0.05)
         for i in range(n_pods):
             name = f"wire-{i}"
             t0 = time.perf_counter()

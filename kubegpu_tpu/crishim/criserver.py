@@ -318,10 +318,13 @@ class CriServer:
         content-stable here, as with tag-pinned digests)."""
         ref = self._image_ref(request)
         digest = "sha256:" + hashlib.sha256(ref.encode()).hexdigest()
+        # strip an existing digest first ('app@sha256:…' keeps ':' in its
+        # last path segment, which fooled the tag check — ADVICE r3), then
         # strip only a TAG (colon after the last '/'): a plain split(':')
         # would truncate registry-port refs like registry:5000/app:v1
-        repo = (ref.rsplit(":", 1)[0]
-                if ":" in ref.rsplit("/", 1)[-1] else ref)
+        base = ref.split("@", 1)[0]
+        repo = (base.rsplit(":", 1)[0]
+                if ":" in base.rsplit("/", 1)[-1] else base)
         with self._lock:
             self._images.setdefault(ref, {
                 "id": digest,

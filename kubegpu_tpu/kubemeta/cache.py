@@ -126,10 +126,11 @@ class WatchCachedApiClient:
             store[key] = obj
 
     def _on_event(self, ev: WatchEvent) -> None:
-        if ev.kind in self._objs:
-            with self._lock:
+        with self._lock:
+            if ev.kind in self._objs:
                 self._apply(ev.kind, ev.obj, deleted=ev.type == "DELETED")
-        for w in list(self._watchers):
+            watchers = list(self._watchers)
+        for w in watchers:
             w(ev)
 
     # -- reads (served locally) -----------------------------------------
@@ -271,11 +272,13 @@ class WatchCachedApiClient:
               ) -> Callable[[], None]:
         """Subscribe to post-apply events: when the callback fires, a
         read through this cache reflects at least that event."""
-        self._watchers.append(callback)
+        with self._lock:
+            self._watchers.append(callback)
 
         def unsubscribe() -> None:
-            if callback in self._watchers:
-                self._watchers.remove(callback)
+            with self._lock:
+                if callback in self._watchers:
+                    self._watchers.remove(callback)
         return unsubscribe
 
     def close(self) -> None:

@@ -225,12 +225,14 @@ class TestCacheOverHttp:
             sched = DeviceScheduler(cache)
             after_init = calls["list"]
             api.create("Pod", tpu_pod("job", chips=1, command=["x"]))
+            # Retry run_once until the watch has delivered everything the
+            # pass needs (ADVICE r3: asserting after a single pass raced
+            # watch delivery of related state under multi-file load).
             deadline = time.monotonic() + 5
-            while time.monotonic() < deadline:
-                if cache.list("Pod"):    # wait for the watch to deliver
-                    break
-                time.sleep(0.02)
             res = sched.run_once()
+            while not res.scheduled and time.monotonic() < deadline:
+                time.sleep(0.02)
+                res = sched.run_once()
             assert res.scheduled == ["job"]
             assert calls["list"] == after_init, \
                 "run_once issued HTTP list calls despite the cache"
