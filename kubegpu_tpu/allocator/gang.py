@@ -765,8 +765,6 @@ class GangAllocator:
                 rect_scored = True
                 if best is None or cand.score > best.score:
                     best = cand
-                if bound <= floor:
-                    break
         if not rect_scored:   # also covers `not ranked` (loop never ran)
             # Non-rectangular totals (e.g. 3 chips in a 2x2 mesh) — or
             # slices where every rectangular ordering fails the
@@ -851,31 +849,34 @@ class GangAllocator:
                               locality=loc, score=score)
         return None
 
-    def _rect_feasible(self, st: SliceState, pl: Placement,
-                       req: GangRequest, axes: dict[str, int]) -> bool:
-        """Does ANY candidate ordering of ``pl`` chunk host-locally?
-        Exactly `_score_placement(...) is not None` — same order set,
-        same filter — but lazy and without ``evaluate_order``, so the
-        below-floor eligibility probe costs chunk checks, not the
-        locality search."""
+    def _feasible_orders(self, st: SliceState, pl: Placement,
+                         req: GangRequest,
+                         axes: dict[str, int]):
+        """Candidate orderings of ``pl`` that chunk host-locally — THE
+        order set both the scorer and the below-floor eligibility probe
+        consume (one generator, so the probe can never drift from
+        ``_score_placement(...) is not None``).  Lazy: the probe stops
+        at the first hit without paying ``evaluate_order``."""
         c = req.chips_per_pod
         ring_span = list(axes.values())[-1] if axes else None
         for o in candidate_orders(pl):
             if _chunks_host_local(st.topo, o, c):
-                return True
-        return any(_chunks_host_local(st.topo, o, c)
-                   for o in _block_orders(st.topo, pl, ring_span))
+                yield o
+        for o in _block_orders(st.topo, pl, ring_span):
+            if _chunks_host_local(st.topo, o, c):
+                yield o
+
+    def _rect_feasible(self, st: SliceState, pl: Placement,
+                       req: GangRequest, axes: dict[str, int]) -> bool:
+        return next(
+            iter(self._feasible_orders(st, pl, req, axes)), None) is not None
 
     def _score_placement(self, st: SliceState, pl: Placement,
                          req: GangRequest, axes: dict[str, int],
                          blocked: set[Coord],
                          fill: float,
                          frag: float | None = None) -> _Candidate | None:
-        c = req.chips_per_pod
-        ring_span = list(axes.values())[-1] if axes else None
-        orders = [o for o in
-                  candidate_orders(pl) + _block_orders(st.topo, pl, ring_span)
-                  if _chunks_host_local(st.topo, o, c)]
+        orders = list(self._feasible_orders(st, pl, req, axes))
         if not orders:
             return None
         best_order, best_loc = None, -1.0

@@ -63,54 +63,97 @@ from kubegpu_tpu.ops.flash_attention import NEG_INF
 # Per-row-position forward (the continuous-batching decode step)
 # ---------------------------------------------------------------------------
 
-def _attend_rows(q: jax.Array, ck: jax.Array, cv: jax.Array,
-                 pos: jax.Array) -> jax.Array:
-    """Grouped cached attention with PER-ROW query positions.
-    q: [B, Hq, 1, D]; cache [B, Hkv, S, D]; pos: [B] (this step's global
-    position per slot).  Row b attends keys at ``k_pos <= pos[b]``."""
+def _attend_rows_buffered(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                          bk: jax.Array, bv: jax.Array,
+                          flush_pos: jax.Array, j: jax.Array) -> jax.Array:
+    """Grouped cached attention with PER-ROW positions over a dense
+    cache PLUS the in-block write buffer.
+
+    q: [B, Hq, 1, D]; cache [B, Hkv, S, D], valid where
+    ``k_pos < flush_pos[b]`` (everything flushed before this block);
+    buffer [B, Hkv, stride, D] holding this block's keys, valid at
+    buffer index ``j' <= j`` (the SHARED in-block step — buffer entry
+    j' is row b's logical position ``flush_pos[b] + j'``).  Softmax is
+    permutation-invariant over the key set, so splitting the keys
+    between cache and buffer changes nothing semantically; the point is
+    that buffer writes land at the shared index j (one
+    dynamic_update_slice, no scatter)."""
     b, hq, t, d = q.shape
     hkv, s = ck.shape[1], ck.shape[2]
+    stride = bk.shape[2]
     qg = q.reshape(b, hkv, hq // hkv, t, d)
     scale = d ** -0.5
-    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, ck,
-                        preferred_element_type=jnp.float32) * scale
+    sc = jnp.einsum("bkgtd,bksd->bkgts", qg, ck,
+                    preferred_element_type=jnp.float32)
+    sb = jnp.einsum("bkgtd,bksd->bkgts", qg, bk,
+                    preferred_element_type=jnp.float32)
+    scores = jnp.concatenate([sc, sb], axis=-1) * scale
     k_pos = jnp.arange(s)
-    mask = k_pos[None, :] <= pos[:, None]              # [B, S]
+    mask = jnp.concatenate(
+        [k_pos[None, :] < flush_pos[:, None],              # [B, S]
+         jnp.broadcast_to(jnp.arange(stride)[None, :] <= j,
+                          (b, stride))], axis=-1)
     scores = jnp.where(mask[:, None, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bksd->bkgtd", probs, cv,
-                     preferred_element_type=jnp.float32)
+    out = (jnp.einsum("bkgts,bksd->bkgtd", probs[..., :s], cv,
+                      preferred_element_type=jnp.float32)
+           + jnp.einsum("bkgts,bksd->bkgtd", probs[..., s:], bv,
+                        preferred_element_type=jnp.float32))
     return out.reshape(b, hq, t, d).astype(q.dtype)
 
 
-def _row_step(params: dict, tokens: jax.Array, cache: dict,
-              pos: jax.Array, cfg: LlamaConfig) -> tuple[jax.Array, dict]:
-    """One decode step for every slot at its OWN position.
-    tokens: [B] current token per slot; pos: [B] its global position.
-    Returns (next-token logits [B, V] f32, updated cache)."""
+def _row_step_buffered(params: dict, tokens: jax.Array, cache: dict,
+                       buf: dict, flush_pos: jax.Array, pos: jax.Array,
+                       j: jax.Array, cfg: LlamaConfig
+                       ) -> tuple[jax.Array, dict]:
+    """One decode step for every slot at its OWN position, writing new
+    K/V into the block buffer at the SHARED index ``j`` instead of
+    scattering into the cache at per-row offsets.
+
+    The r3 engine's vmapped per-slot ``dynamic_update_slice`` lowered
+    to a scatter that cost 21% of the step (1.56 vs 1.23 ms measured,
+    BASELINE.md r3); the buffer write is a plain shared-offset update,
+    and the scatter happens ONCE per stride-block at flush time.
+    tokens: [B]; pos: [B] each row's global position (rope);
+    flush_pos: [B] positions at block start (cache validity).
+    Returns (next-token logits [B, V] f32, updated buffer)."""
     x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]   # [B,1,D]
     positions = pos[:, None]                                    # [B,1]
 
-    def write_row(c, kv, p):
-        # one slot's cache panel [Hkv, S, D] ← its new row at p
-        return lax.dynamic_update_slice(c, kv.astype(c.dtype), (0, p, 0))
-
     def layer(x, xs):
-        lp, ck, cv = xs
+        lp, ck, cv, bk, bv = xs
         h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(h, lp, cfg, positions)   # [B,H,1,D]
-        ck = jax.vmap(write_row)(ck, k, pos)
-        cv = jax.vmap(write_row)(cv, v, pos)
-        o = _attend_rows(q, ck, cv, pos)
+        bk = lax.dynamic_update_slice(bk, k.astype(bk.dtype),
+                                      (0, 0, j, 0))
+        bv = lax.dynamic_update_slice(bv, v.astype(bv.dtype),
+                                      (0, 0, j, 0))
+        o = _attend_rows_buffered(q, ck, cv, bk, bv, flush_pos, j)
         return _attn_finish(
             x, o, lp, cfg,
-            lambda x_, lp_: _dense_ffn(x_, lp_, cfg)), (ck, cv)
+            lambda x_, lp_: _dense_ffn(x_, lp_, cfg)), (bk, bv)
 
-    x, (ck_new, cv_new) = lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"]))
+    x, (bk_new, bv_new) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"],
+                   buf["k"], buf["v"]))
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits[:, 0], {"k": ck_new, "v": cv_new}
+    return logits[:, 0], {"k": bk_new, "v": bv_new}
+
+
+def _flush_buffer(cache: dict, buf: dict, flush_pos: jax.Array) -> dict:
+    """Scatter the block buffer into the dense cache — the ONE per-row
+    write of a stride-block.  cache [L, B, Hkv, S, D]; buf
+    [L, B, Hkv, stride, D]; row b's segment lands at ``flush_pos[b]``."""
+
+    def write_seg(c, seg, p):     # [Hkv, S, D] ← [Hkv, stride, D] at p
+        return lax.dynamic_update_slice(c, seg.astype(c.dtype),
+                                        (0, p, 0))
+
+    write = jax.vmap(jax.vmap(write_seg, in_axes=(0, 0, 0)),
+                     in_axes=(0, 0, None))          # over L, then B
+    return {"k": write(cache["k"], buf["k"], flush_pos),
+            "v": write(cache["v"], buf["v"], flush_pos)}
 
 
 @functools.lru_cache(maxsize=32)
@@ -143,24 +186,34 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
         """``stride`` decode steps for all slots in ONE dispatch.
         Per-slot greedy/sampled feedback; inactive slots hold position
         (their garbage output is never emitted and their rows never
-        advance).  The tick folds into the key INSIDE the jit (an
+        advance).  New K/V rides the write buffer at the shared step
+        index and is flushed to the cache once at block end — the
+        per-row scatter is paid 1/stride as often as the r3 engine
+        paid it.  The tick folds into the key INSIDE the jit (an
         eager fold_in would cost dispatches on an engine built to
         avoid them).  Returns (token block [stride, B], last tokens,
         pos', cache)."""
         keys = jax.random.split(
             jax.random.fold_in(jax.random.fold_in(base_key, 0), tick),
             stride)
+        flush_pos = pos                     # block-start positions [B]
+        shape = cache["k"].shape            # [L, B, Hkv, S, D]
+        buf = {n: jnp.zeros(shape[:3] + (stride,) + shape[4:],
+                            cache[n].dtype) for n in ("k", "v")}
 
-        def step(carry, k_):
-            tokens, pos, cache = carry
-            logits, cache = _row_step(params, tokens, cache, pos, cfg)
+        def step(carry, xs):
+            tokens, pos, buf = carry
+            j, k_ = xs
+            logits, buf = _row_step_buffered(
+                params, tokens, cache, buf, flush_pos, pos, j, cfg)
             nxt = _pick(logits, temps, k_).astype(tokens.dtype)
             nxt = jnp.where(active, nxt, tokens)
             pos = jnp.where(active, pos + 1, pos)
-            return (nxt, pos, cache), nxt
+            return (nxt, pos, buf), nxt
 
-        (tokens, pos, cache), block = lax.scan(
-            step, (tokens, pos, cache), keys)
+        (tokens, pos, buf), block = lax.scan(
+            step, (tokens, pos, buf), (jnp.arange(stride), keys))
+        cache = _flush_buffer(cache, buf, flush_pos)
         return block, tokens, pos, cache
 
     @jax.jit
