@@ -107,10 +107,13 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
 
 # -- server -------------------------------------------------------------
 
-class CriServer:
-    """RuntimeService-shaped server fronting the injection shim + the
-    real runtime for one node.  ``start()`` binds the unix socket and
-    serves in a daemon thread; ``close()`` shuts down and unlinks."""
+class CriVerbs:
+    """The CRI verb core — RuntimeService + ImageService semantics for
+    one node, transport-free.  :class:`CriServer` (length-prefixed JSON
+    frames) and :class:`~kubegpu_tpu.crishim.grpcserver.GrpcCriServer`
+    (real gRPC, the reference's actual transport — SURVEY.md §2 L2)
+    both dispatch into this object, so the two wire formats can never
+    diverge semantically."""
 
     def __init__(self, api: FakeApiServer, backend: DeviceBackend,
                  node_name: str, runtime: ContainerRuntime,
@@ -132,58 +135,6 @@ class CriServer:
         self._images: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
-
-        dispatch = self._dispatch
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self) -> None:
-                while True:
-                    try:
-                        frame = recv_frame(self.request)
-                    except (ConnectionError, OSError):
-                        return
-                    if frame is None:
-                        return
-                    try:
-                        out = dispatch(str(frame.get("method", "")),
-                                       frame.get("request") or {})
-                        reply = {"response": out}
-                    except Exception as e:  # carried in-band, conn survives
-                        reply = {"error": f"{type(e).__name__}: {e}"}
-                    try:
-                        send_frame(self.request, reply)
-                    except (ConnectionError, OSError):
-                        return
-
-        class Server(socketserver.ThreadingUnixStreamServer):
-            daemon_threads = True
-            allow_reuse_address = True
-
-        self._server = Server(self.socket_path, Handler)
-
-    # -- lifecycle ------------------------------------------------------
-
-    def start(self) -> "CriServer":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
-        self._thread.start()
-        log.info("listening", socket=self.socket_path, node=self.node_name)
-        return self
-
-    def close(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
-        if self._tmpdir is not None:
-            try:
-                os.rmdir(self._tmpdir)
-            except OSError:
-                pass
 
     # -- verbs ----------------------------------------------------------
 
@@ -384,6 +335,75 @@ class CriServer:
 
 
 # -- client -------------------------------------------------------------
+
+    def _cleanup_socket(self) -> None:
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        if self._tmpdir is not None:
+            try:
+                os.rmdir(self._tmpdir)
+            except OSError:
+                pass
+
+
+class CriServer(CriVerbs):
+    """RuntimeService-shaped server fronting the injection shim + the
+    real runtime for one node, speaking length-prefixed JSON frames.
+    ``start()`` binds the unix socket and serves in a daemon thread;
+    ``close()`` shuts down and unlinks."""
+
+    def __init__(self, api: FakeApiServer, backend: DeviceBackend,
+                 node_name: str, runtime: ContainerRuntime,
+                 socket_path: str | None = None):
+        super().__init__(api, backend, node_name, runtime, socket_path)
+
+        dispatch = self._dispatch
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                while True:
+                    try:
+                        frame = recv_frame(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    if frame is None:
+                        return
+                    try:
+                        out = dispatch(str(frame.get("method", "")),
+                                       frame.get("request") or {})
+                        reply = {"response": out}
+                    except Exception as e:  # carried in-band, conn survives
+                        reply = {"error": f"{type(e).__name__}: {e}"}
+                    try:
+                        send_frame(self.request, reply)
+                    except (ConnectionError, OSError):
+                        return
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server(self.socket_path, Handler)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "CriServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        log.info("listening", socket=self.socket_path, node=self.node_name)
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._cleanup_socket()
+
+
 
 class CriClient:
     """Thread-safe frame client: one persistent connection, calls

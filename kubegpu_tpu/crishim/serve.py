@@ -48,11 +48,20 @@ def build_agent(args):
     else:
         runtime = FakeRuntime()
     node_name = backend.discover().node_name
-    server = CriServer(api, backend, node_name, runtime,
-                       socket_path=args.cri_socket).start()
+    if getattr(args, "transport", "json") == "grpc":
+        from kubegpu_tpu.crishim.grpcserver import (
+            GrpcCriServer,
+            GrpcRemoteCriShim,
+        )
+        server = GrpcCriServer(api, backend, node_name, runtime,
+                               socket_path=args.cri_socket).start()
+        shim = GrpcRemoteCriShim(server.socket_path)
+    else:
+        server = CriServer(api, backend, node_name, runtime,
+                           socket_path=args.cri_socket).start()
+        shim = RemoteCriShim(server.socket_path)
     agent = NodeAgent(api, backend, runtime,
-                      metrics=global_registry,
-                      shim=RemoteCriShim(server.socket_path))
+                      metrics=global_registry, shim=shim)
     return api, server, agent
 
 
@@ -69,6 +78,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="mock backend slice type")
     ap.add_argument("--host-id", type=int, default=0,
                     help="mock backend host index within the slice")
+    ap.add_argument("--transport", default="json",
+                    choices=("json", "grpc"),
+                    help="CRI wire transport: length-prefixed JSON "
+                         "frames or real gRPC (runtime.v1 services)")
     ap.add_argument("--cri-socket", default=None,
                     help="unix socket path for the CRI server "
                     "(default: a fresh temp path, printed at startup)")

@@ -141,6 +141,107 @@ def _row_step_buffered(params: dict, tokens: jax.Array, cache: dict,
     return logits[:, 0], {"k": bk_new, "v": bv_new}
 
 
+def _attend_buffer_partials(q: jax.Array, bk: jax.Array, bv: jax.Array,
+                            j: jax.Array
+                            ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Softmax partials over the in-block write buffer only (valid at
+    buffer index <= j, shared across rows).  q: [B, Hq, 1, D]; buffer
+    [B, Hkv, stride, D].  Returns (o [B, Hq, D] f32 normalized,
+    m [B, Hq], l [B, Hq]) for the flash-decoding merge with the paged
+    pool's partials."""
+    b, hq, t, d = q.shape
+    hkv, stride = bk.shape[1], bk.shape[2]
+    qg = q.reshape(b, hkv, hq // hkv, d)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg, bk,
+                   preferred_element_type=jnp.float32) * (d ** -0.5)
+    mask = (jnp.arange(stride) <= j)[None, None, None, :]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    w = jnp.where(mask, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(w, axis=-1)
+    o = jnp.einsum("bkgs,bksd->bkgd", w.astype(bv.dtype), bv,
+                   preferred_element_type=jnp.float32)
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    return (o.reshape(b, hq, d), m.reshape(b, hq), l.reshape(b, hq))
+
+
+def _paged_row_step(params: dict, tokens: jax.Array, pool: dict,
+                    pt: jax.Array, tvec: jax.Array, tpad: jax.Array,
+                    d0: jax.Array, buf: dict, pos: jax.Array,
+                    j: jax.Array, cfg: LlamaConfig, interpret: bool
+                    ) -> tuple[jax.Array, dict]:
+    """One decode step for every slot against the PAGED pool: flushed
+    history via the pallas paged-attention kernel (reads only the pages
+    each row actually holds), this block's keys via the write buffer,
+    combined with the flash-decoding logsumexp merge.  Layers scan over
+    (params, buffer, layer index); the pool rides as a loop-invariant
+    closure so nothing pool-sized is ever sliced or copied."""
+    from kubegpu_tpu.ops.paged_attention import (
+        merge_partials,
+        paged_attention,
+    )
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]   # [B,1,D]
+    positions = pos[:, None]
+    pool_k, pool_v = pool["k"], pool["v"]
+
+    def layer(x, xs):
+        lp, bk, bv, li = xs
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(h, lp, cfg, positions)   # [B,H,1,D]
+        bk = lax.dynamic_update_slice(bk, k.astype(bk.dtype),
+                                      (0, 0, j, 0))
+        bv = lax.dynamic_update_slice(bv, v.astype(bv.dtype),
+                                      (0, 0, j, 0))
+        o_p, m_p, l_p = paged_attention(
+            q[:, :, 0, :], pool_k, pool_v, pt, li, tvec, tpad, d0,
+            interpret=interpret)
+        o_b, m_b, l_b = _attend_buffer_partials(q, bk, bv, j)
+        o = merge_partials(o_p, m_p, l_p, o_b, m_b, l_b)
+        o = o[:, :, None, :].astype(x.dtype)            # [B,Hq,1,D]
+        return _attn_finish(
+            x, o, lp, cfg,
+            lambda x_, lp_: _dense_ffn(x_, lp_, cfg)), (bk, bv)
+
+    lidx = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    x, (bk_new, bv_new) = lax.scan(
+        layer, x, (params["layers"], buf["k"], buf["v"], lidx))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits[:, 0], {"k": bk_new, "v": bv_new}
+
+
+def _flush_buffer_paged(pool: dict, buf: dict, pt: jax.Array,
+                        tpad: jax.Array, d0: jax.Array,
+                        page_size: int) -> dict:
+    """Scatter the block buffer into each row's CURRENT decode page.
+    pool [L, n_pages, Hkv, P, D]; buf [L, B, Hkv, stride, D].  The
+    decode region is page-aligned and stride divides P, so a block
+    never splits a page.  Retired/never-admitted rows carry a zeroed
+    page-table row, so their garbage lands in trash page 0 (never
+    allocated); the page INDEX clamp keeps their stale positions from
+    indexing past the table."""
+    n_slots = buf["k"].shape[1]
+    max_pages = pt.shape[1]
+    phys0 = tpad + d0
+    pidx = jnp.clip(phys0 // page_size, 0, max_pages - 1)
+    page = jnp.take_along_axis(pt, pidx[:, None], axis=1)[:, 0]   # [B]
+    off = phys0 % page_size
+
+    def write_row(b, pool_kv):
+        pk, pv = pool_kv
+        # [L, 1, Hkv, stride, D] → pool at (layer *, page, head *, off, *)
+        seg_k = lax.dynamic_slice_in_dim(buf["k"], b, 1, axis=1)
+        seg_v = lax.dynamic_slice_in_dim(buf["v"], b, 1, axis=1)
+        start = (0, page[b], 0, off[b], 0)
+        pk = lax.dynamic_update_slice(pk, seg_k.astype(pk.dtype), start)
+        pv = lax.dynamic_update_slice(pv, seg_v.astype(pv.dtype), start)
+        return pk, pv
+
+    pk, pv = lax.fori_loop(
+        0, n_slots, write_row, (pool["k"], pool["v"]))
+    return {"k": pk, "v": pv}
+
+
 def _flush_buffer(cache: dict, buf: dict, flush_pos: jax.Array) -> dict:
     """Scatter the block buffer into the dense cache — the ONE per-row
     write of a stride-block.  cache [L, B, Hkv, S, D]; buf
@@ -180,7 +281,7 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
                                 jnp.float32(1.0), top_k, nucleus=False)
         return jnp.where(temps > 0, sampled, greedy)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnames=("cache",))
     def decode_block(params, cache, tokens, pos, active, temps,
                      base_key, tick):
         """``stride`` decode steps for all slots in ONE dispatch.
@@ -189,10 +290,11 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
         advance).  New K/V rides the write buffer at the shared step
         index and is flushed to the cache once at block end — the
         per-row scatter is paid 1/stride as often as the r3 engine
-        paid it.  The tick folds into the key INSIDE the jit (an
-        eager fold_in would cost dispatches on an engine built to
-        avoid them).  Returns (token block [stride, B], last tokens,
-        pos', cache)."""
+        paid it.  The cache is DONATED (the engine rebinds it every
+        tick; without donation the flush copies the whole cache).  The
+        tick folds into the key INSIDE the jit (an eager fold_in would
+        cost dispatches on an engine built to avoid them).  Returns
+        (token block [stride, B], last tokens, pos', cache)."""
         keys = jax.random.split(
             jax.random.fold_in(jax.random.fold_in(base_key, 0), tick),
             stride)
@@ -237,14 +339,17 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
         key = jax.random.fold_in(jax.random.fold_in(base_key, 1), rid0)
         return _pick(last, temps_w, key).astype(jnp.int32), cache_w
 
-    @functools.partial(jax.jit, static_argnames=("k",))
+    @functools.partial(jax.jit, static_argnames=("k",),
+                       donate_argnames=("cache",))
     def adopt_wave(cache, cache_w, slots, firsts, plens, temps_w,
                    first_toks, tokens, pos, temps, k):
         """Admit a whole wave in ONE dispatch: scatter the batch-k
         cache's rows into (possibly non-contiguous) slots and update
         every per-slot device vector.  (Eager ``.at[].set`` ops per
         admission each cost a dispatch — under the tunnel that
-        overhead rivaled the decode itself.)"""
+        overhead rivaled the decode itself.)  The big cache is DONATED
+        — an r4 on-chip measurement caught each un-donated adoption
+        copying the whole cache (~3 s of a 16-request drain)."""
         for i in range(k):   # k is static: unrolled slice-updates
             cache = jax.tree.map(
                 lambda big, w: lax.dynamic_update_slice(
@@ -261,6 +366,122 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
             temps = lax.dynamic_update_slice(
                 temps, temps_w[i:i + 1], (slots[i],))
         return cache, first_toks, tokens, pos, temps
+
+    return decode_block, prefill_wave, adopt_wave
+
+
+def _pick_token(logits, temps, k_, top_k: int, sampling: bool):
+    """Per-slot greedy/sampled selection shared by both engine modes."""
+    greedy = jnp.argmax(logits, axis=-1)
+    if not sampling:
+        return greedy
+    from kubegpu_tpu.models.decode import _sample_token
+    sampled = _sample_token(logits, k_, temps[:, None],
+                            jnp.float32(1.0), top_k, nucleus=False)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+@functools.lru_cache(maxsize=32)
+def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
+                      page_size: int, stride: int, top_k: int = 0,
+                      sampling: bool = False, interpret: bool = False):
+    """Jitted engine pieces for the PAGED cache mode: the KV history
+    lives in a page pool [L, n_pages, Hkv, P, D] shared by all slots
+    (page 0 is a trash page, never allocated), addressed through a
+    host-managed per-slot page table uploaded with each block dispatch.
+    Same write-buffer structure as the dense mode; the flushed history
+    is read by the pallas paged-attention kernel, which only fetches
+    the pages a row actually holds."""
+
+    def _pick(logits, temps, k_):
+        return _pick_token(logits, temps, k_, top_k, sampling)
+
+    @functools.partial(jax.jit, donate_argnames=("pool",))
+    def decode_block(params, pool, pt, tvec, tpad, tokens, pos, active,
+                     temps, base_key, tick):
+        """``stride`` decode steps against the paged pool in ONE
+        dispatch.  ``tvec``/``tpad``: per-row prompt length and
+        (page-aligned) decode-region start; flushed decode count is
+        ``pos - tvec`` for active rows and pinned to 0 for inactive
+        ones (their page-table rows are zeroed at retirement, so
+        nothing they touch is live).  The pool is donated: the engine
+        rebinds it every tick, and without donation every flush would
+        copy the whole pool."""
+        keys = jax.random.split(
+            jax.random.fold_in(jax.random.fold_in(base_key, 0), tick),
+            stride)
+        d0 = jnp.where(active, pos - tvec, 0)
+        shape = pool["k"].shape            # [L, n_pages, Hkv, P, D]
+        buf = {n: jnp.zeros((shape[0], n_slots, shape[2], stride,
+                             shape[4]), pool[n].dtype)
+               for n in ("k", "v")}
+
+        def step(carry, xs):
+            tokens, pos, buf = carry
+            j, k_ = xs
+            logits, buf = _paged_row_step(
+                params, tokens, pool, pt, tvec, tpad, d0, buf, pos, j,
+                cfg, interpret)
+            nxt = _pick(logits, temps, k_).astype(tokens.dtype)
+            nxt = jnp.where(active, nxt, tokens)
+            pos = jnp.where(active, pos + 1, pos)
+            return (nxt, pos, buf), nxt
+
+        (tokens, pos, buf), block = lax.scan(
+            step, (tokens, pos, buf), (jnp.arange(stride), keys))
+        pool = _flush_buffer_paged(pool, buf, pt, tpad, d0, page_size)
+        return block, tokens, pos, pool
+
+    @jax.jit
+    def prefill_wave(params, padded_prompts, true_lens, temps_w,
+                     base_key, rid0):
+        """Batch-k prefill producing a DENSE [L, k, Hkv, bucket, D]
+        panel (bucket is a multiple of the page size) for page-wise
+        adoption.  First-token selection identical to the dense mode."""
+        from kubegpu_tpu.models.decode import _forward_with_cache
+        k = padded_prompts.shape[0]
+        bucket = padded_prompts.shape[1]
+        cache_w = init_kv_cache(cfg, k, bucket)
+        logits, cache_w = _forward_with_cache(
+            params, padded_prompts, cache_w, jnp.int32(0), cfg)
+        last = jnp.take_along_axis(
+            logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+        key = jax.random.fold_in(jax.random.fold_in(base_key, 1), rid0)
+        return _pick(last, temps_w, key).astype(jnp.int32), cache_w
+
+    @functools.partial(jax.jit, static_argnames=("k",),
+                       donate_argnames=("pool",))
+    def adopt_wave(pool, cache_w, page_dst, slots, firsts, plens,
+                   temps_w, first_toks, tokens, pos, temps, k):
+        """Admit a wave: copy each row's prompt panel page-by-page into
+        its allocated pool pages (``page_dst`` [k, bucket/P] pool page
+        ids) and update the per-slot device vectors.  k and the page
+        count are static — unrolled slice updates, in-place on the
+        donated pool."""
+        bucket = cache_w["k"].shape[3]
+        n_pages_row = bucket // page_size
+        for i in range(k):
+            for pi in range(n_pages_row):
+                src_k = cache_w["k"][:, i:i + 1, :,
+                                     pi * page_size:(pi + 1) * page_size]
+                src_v = cache_w["v"][:, i:i + 1, :,
+                                     pi * page_size:(pi + 1) * page_size]
+                start = (0, page_dst[i, pi], 0, 0, 0)
+                pool = {
+                    "k": lax.dynamic_update_slice(
+                        pool["k"], src_k.astype(pool["k"].dtype), start),
+                    "v": lax.dynamic_update_slice(
+                        pool["v"], src_v.astype(pool["v"].dtype), start),
+                }
+            first_toks = lax.dynamic_update_slice(
+                first_toks, firsts[i:i + 1], (slots[i],))
+            tokens = lax.dynamic_update_slice(
+                tokens, firsts[i:i + 1], (slots[i],))
+            pos = lax.dynamic_update_slice(
+                pos, plens[i:i + 1], (slots[i],))
+            temps = lax.dynamic_update_slice(
+                temps, temps_w[i:i + 1], (slots[i],))
+        return pool, first_toks, tokens, pos, temps
 
     return decode_block, prefill_wave, adopt_wave
 
@@ -295,7 +516,8 @@ class ContinuousBatcher:
                  max_len: int | None = None, stride: int = 16,
                  prompt_buckets: tuple[int, ...] = (128, 512, 1024),
                  sampling: bool = False, top_k: int = 0, seed: int = 0,
-                 max_wave: int = 1):
+                 max_wave: int = 1, paged: bool = False,
+                 page_size: int = 128, total_pages: int | None = None):
         if not 0 <= top_k <= cfg.vocab_size:
             raise ValueError(
                 f"top_k {top_k} not in [0, vocab_size={cfg.vocab_size}]")
@@ -318,9 +540,47 @@ class ContinuousBatcher:
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         if self.prompt_buckets[-1] >= self.max_len:
             raise ValueError("largest prompt bucket must be < max_len")
-        self._fns = _engine_fns(cfg, n_slots, self.max_len, stride,
-                                top_k, sampling)
-        self.cache = init_kv_cache(cfg, n_slots, self.max_len)
+        self.paged = paged
+        if paged:
+            from kubegpu_tpu.ops.paged_attention import page_table_size
+            if page_size % stride:
+                raise ValueError(
+                    f"page_size {page_size} must be a multiple of "
+                    f"stride {stride} (block flushes must not split a "
+                    "page)")
+            if any(b % page_size for b in self.prompt_buckets):
+                raise ValueError(
+                    f"prompt buckets {self.prompt_buckets} must be "
+                    f"multiples of page_size {page_size}")
+            self.page_size = page_size
+            # a row's physical span: its bucket (the page-aligned
+            # prompt region, which may exceed the true prompt length)
+            # + its decode region; bucket_max + max_len bounds any row
+            self.max_pages = page_table_size(
+                self.prompt_buckets[-1] + self.max_len, page_size)
+            # pool page 0 is TRASH: retired rows' page tables zero out,
+            # so their per-block garbage flush lands somewhere no live
+            # row reads.  Capacity is set INDEPENDENTLY of n_slots —
+            # the dense mode's n_slots x max_len HBM bound is gone.
+            self.total_pages = (total_pages if total_pages is not None
+                                else n_slots * self.max_pages)
+            interpret = jax.devices()[0].platform == "cpu"
+            self._fns = _paged_engine_fns(
+                cfg, n_slots, self.max_pages, page_size, stride, top_k,
+                sampling, interpret)
+            shape = (cfg.n_layers, self.total_pages + 1, cfg.n_kv_heads,
+                     page_size, cfg.head_dim)
+            self.pool = {"k": jnp.zeros(shape, cfg.jdtype),
+                         "v": jnp.zeros(shape, cfg.jdtype)}
+            self._free_pages = list(range(1, self.total_pages + 1))
+            self._pt = np.zeros((n_slots, self.max_pages), np.int32)
+            self._tvec = np.zeros((n_slots,), np.int32)
+            self._tpad = np.zeros((n_slots,), np.int32)
+            self._slot_pages: dict[int, list[int]] = {}
+        else:
+            self._fns = _engine_fns(cfg, n_slots, self.max_len, stride,
+                                    top_k, sampling)
+            self.cache = init_kv_cache(cfg, n_slots, self.max_len)
         self.tokens = jnp.zeros((n_slots,), jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.temps = jnp.zeros((n_slots,), jnp.float32)
@@ -347,6 +607,7 @@ class ContinuousBatcher:
         #                              prefill-produced first token)
         self._decode_tokens = 0      # tokens produced BY decode steps
         self.slot_steps = 0          # decode slot-steps spent
+        self.prefill_waves = 0       # admission waves dispatched
 
     def warmup(self) -> None:
         """Compile every executable this engine can hit — the decode
@@ -358,6 +619,34 @@ class ContinuousBatcher:
         mid-measurement (observed eating ~95% of a flagship run)."""
         decode_block, prefill_wave, adopt_wave = self._fns
         outs = []
+        # Every executable DONATES its big KV argument, so warmup
+        # chains a scratch pool/cache through the calls and never
+        # touches the live one (donating it would invalidate it).
+        scratch = jax.tree.map(
+            jnp.zeros_like, self.pool if self.paged else self.cache)
+
+        def adopt(scratch, cache_w, k, bucket, firsts, lens, temps):
+            common = (jnp.arange(k, dtype=jnp.int32), firsts, lens,
+                      temps, self.first_toks, self.tokens, self.pos,
+                      self.temps, k)
+            if self.paged:
+                page_dst = jnp.zeros(
+                    (k, bucket // self.page_size), jnp.int32)
+                return adopt_wave(scratch, cache_w, page_dst, *common)
+            return adopt_wave(scratch, cache_w, *common)
+
+        def block(scratch):
+            if self.paged:
+                return decode_block(
+                    self.params, scratch, jnp.asarray(self._pt),
+                    jnp.asarray(self._tvec), jnp.asarray(self._tpad),
+                    self.tokens, self.pos, jnp.asarray(self.active),
+                    self.temps, self._base_key, jnp.int32(0))
+            return decode_block(
+                self.params, scratch, self.tokens, self.pos,
+                jnp.asarray(self.active), self.temps, self._base_key,
+                jnp.int32(0))
+
         for bucket in self.prompt_buckets:
             k = 1
             while k <= min(self.n_slots, self.max_wave):
@@ -365,18 +654,14 @@ class ContinuousBatcher:
                 lens = jnp.ones((k,), jnp.int32)
                 temps = jnp.zeros((k,), jnp.float32)
                 firsts, cache_w = prefill_wave(
-                    self.params, padded, lens, temps, self._base_key,
-                    jnp.int32(0))
-                outs.append(adopt_wave(
-                    self.cache, cache_w,
-                    jnp.arange(k, dtype=jnp.int32), firsts, lens,
-                    temps, self.first_toks, self.tokens, self.pos,
-                    self.temps, k)[1])
+                    self.params, padded, lens, temps,
+                    self._base_key, jnp.int32(0))
+                scratch, ft, *_ = adopt(scratch, cache_w, k, bucket,
+                                        firsts, lens, temps)
+                outs.append(ft)
                 k *= 2
-        outs.append(decode_block(
-            self.params, self.cache, self.tokens, self.pos,
-            jnp.asarray(self.active), self.temps, self._base_key,
-            jnp.int32(0))[0])
+        blk, _, _, scratch = block(scratch)
+        outs.append(blk)
         for o in outs:   # block until every compile finished
             np.asarray(o)
 
@@ -412,6 +697,15 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {t} + max_new {max_new_tokens} + stride "
                 f"{self.stride} > max_len {self.max_len}")
+        if self.paged:
+            need = self._pages_needed(max_new_tokens, bucket)
+            if need > self.total_pages:
+                # an unfittable request would park at the queue front
+                # and stall FIFO admission forever — reject at submit
+                raise ValueError(
+                    f"request needs {need} pages (bucket {bucket} + "
+                    f"{max_new_tokens} new tokens) but the pool has "
+                    f"only {self.total_pages}")
         padded = jnp.zeros((1, bucket), jnp.int32).at[0, :t].set(prompt)
         req = _Request(rid=self._next_rid, prompt_len=t,
                        max_new_tokens=max_new_tokens,
@@ -422,11 +716,28 @@ class ContinuousBatcher:
 
     # -- the engine tick ------------------------------------------------
 
+    def _pages_needed(self, max_new_tokens: int, bucket: int) -> int:
+        """Pool pages a request occupies for its whole lifetime: its
+        prompt bucket plus the decode extent its blocks will flush
+        (full stride blocks, so garbage tails are still owned pages)."""
+        blocks = -(-(max_new_tokens - 1) // self.stride)
+        dec_pages = -(-(blocks * self.stride) // self.page_size)
+        return bucket // self.page_size + dec_pages
+
     def _admit(self) -> None:
         decode_block, prefill_wave, adopt_wave = self._fns
         free = [s for s in range(self.n_slots)
                 if s not in self.slot_req]
         while free and self.queue:
+            if self.paged:
+                # page-admission gate: the queue FRONT must fit (FIFO
+                # is preserved — nothing jumps a request that is only
+                # waiting for pages)
+                req0, p0 = self.queue[0]
+                if self._pages_needed(
+                        req0.max_new_tokens,
+                        p0.shape[1]) > len(self._free_pages):
+                    break
             # WAVE admission: consecutive queue-front requests sharing
             # one prompt bucket prefill as a single [k, bucket] batch
             # (one prefill + one adopt dispatch instead of 2k, and the
@@ -445,6 +756,14 @@ class ContinuousBatcher:
             k = 1
             while k * 2 <= min(n_same, len(free), self.max_wave):
                 k *= 2
+            if self.paged:
+                # shrink the wave until its TOTAL page need fits (the
+                # front alone was already checked, so k >= 1 survives)
+                while k > 1 and sum(
+                        self._pages_needed(r.max_new_tokens, bucket)
+                        for r, _ in list(self.queue)[:k]
+                        ) > len(self._free_pages):
+                    k //= 2
             wave = [self.queue.popleft() for _ in range(k)]
             slots = [free.pop(0) for _ in range(k)]
             padded = jnp.concatenate([p for _, p in wave], axis=0)
@@ -455,13 +774,34 @@ class ContinuousBatcher:
             firsts, cache_w = prefill_wave(
                 self.params, padded, true_lens, temps_w,
                 self._base_key, jnp.int32(wave[0][0].rid))
+            self.prefill_waves += 1
             # two dispatches per WAVE, zero host fetches: first-token
             # values reach req.tokens at the next tick's fused fetch
-            (self.cache, self.first_toks, self.tokens,
-             self.pos, self.temps) = adopt_wave(
-                self.cache, cache_w, jnp.asarray(slots, jnp.int32),
-                firsts, true_lens, temps_w, self.first_toks,
-                self.tokens, self.pos, self.temps, k)
+            if self.paged:
+                n_prompt_pages = bucket // self.page_size
+                page_dst = np.zeros((k, n_prompt_pages), np.int32)
+                for i, (slot, (req, _)) in enumerate(zip(slots, wave)):
+                    need = self._pages_needed(req.max_new_tokens, bucket)
+                    pages = [self._free_pages.pop()
+                             for _ in range(need)]
+                    self._slot_pages[slot] = pages
+                    self._pt[slot, :] = 0
+                    self._pt[slot, :need] = pages
+                    self._tvec[slot] = req.prompt_len
+                    self._tpad[slot] = bucket
+                    page_dst[i] = pages[:n_prompt_pages]
+                (self.pool, self.first_toks, self.tokens,
+                 self.pos, self.temps) = adopt_wave(
+                    self.pool, cache_w, jnp.asarray(page_dst),
+                    jnp.asarray(slots, jnp.int32), firsts, true_lens,
+                    temps_w, self.first_toks, self.tokens, self.pos,
+                    self.temps, k)
+            else:
+                (self.cache, self.first_toks, self.tokens,
+                 self.pos, self.temps) = adopt_wave(
+                    self.cache, cache_w, jnp.asarray(slots, jnp.int32),
+                    firsts, true_lens, temps_w, self.first_toks,
+                    self.tokens, self.pos, self.temps, k)
             for slot, (req, _) in zip(slots, wave):
                 self.active[slot] = req.max_new_tokens > 1
                 self.slot_req[slot] = req
@@ -484,10 +824,20 @@ class ContinuousBatcher:
         finished = self._collect()
         self._admit()
         if self.slot_req:
-            block, self.tokens, self.pos, self.cache = decode_block(
-                self.params, self.cache, self.tokens, self.pos,
-                jnp.asarray(self.active), self.temps, self._base_key,
-                jnp.int32(self._tick))
+            if self.paged:
+                # the page table and per-row length scalars ride the
+                # block dispatch as tiny int32 uploads — retirement and
+                # admission mutate them host-side for free
+                block, self.tokens, self.pos, self.pool = decode_block(
+                    self.params, self.pool, jnp.asarray(self._pt),
+                    jnp.asarray(self._tvec), jnp.asarray(self._tpad),
+                    self.tokens, self.pos, jnp.asarray(self.active),
+                    self.temps, self._base_key, jnp.int32(self._tick))
+            else:
+                block, self.tokens, self.pos, self.cache = decode_block(
+                    self.params, self.cache, self.tokens, self.pos,
+                    jnp.asarray(self.active), self.temps,
+                    self._base_key, jnp.int32(self._tick))
             self._tick += 1
             # fuse NOW (after admissions): newly admitted requests'
             # first tokens ride this block's fetch
@@ -513,6 +863,7 @@ class ContinuousBatcher:
                 finished.append(req)
                 del self.slot_req[slot]
                 self.active[slot] = False
+                self._release_pages(slot)
                 continue
             want = req.max_new_tokens - len(req.tokens)
             take = min(self.stride, want)
@@ -524,7 +875,20 @@ class ContinuousBatcher:
                 finished.append(req)
                 del self.slot_req[slot]
                 self.active[slot] = False
+                self._release_pages(slot)
         return finished
+
+    def _release_pages(self, slot: int) -> None:
+        """Paged retirement: return the slot's pages to the free list
+        and zero its table row + length scalars, so the slot's
+        per-block garbage flush retargets trash page 0 and its pages
+        can be handed to the next admission immediately."""
+        if not self.paged:
+            return
+        self._free_pages.extend(self._slot_pages.pop(slot, []))
+        self._pt[slot, :] = 0
+        self._tvec[slot] = 0
+        self._tpad[slot] = 0
 
     def drain(self, max_ticks: int = 10_000) -> list[_Request]:
         """Run until queue and slots are empty; returns every finished

@@ -266,7 +266,8 @@ class TestDaemonProcess:
     SUCCEEDED with no in-process shortcut anywhere."""
 
     @pytest.mark.slow
-    def test_pod_runs_through_external_daemon(self, tmp_path):
+    @pytest.mark.parametrize("transport", ["json", "grpc"])
+    def test_pod_runs_through_external_daemon(self, tmp_path, transport):
         import subprocess
         import sys as _sys
 
@@ -278,7 +279,7 @@ class TestDaemonProcess:
         proc = subprocess.Popen(
             [_sys.executable, "-m", "kubegpu_tpu.crishim.serve",
              "--apiserver", srv.address, "--backend", "mock",
-             "--slice", "v4-8",
+             "--slice", "v4-8", "--transport", transport,
              "--cri-socket", str(tmp_path / "cri.sock"),
              "--real-processes", "--tick", "0.05",
              "--advertise-interval", "1"],

@@ -36,9 +36,15 @@ class TestModelBench:
                             "beam", "spec_decode",
                             "continuous_batching"}
         cb = fam["continuous_batching"]
-        assert cb["e2e_tokens_per_s_rtt_adjusted"] > 0
+        assert cb["e2e_tokens_per_s_anchored"] > 0
         assert cb["decode_tokens_per_s"] > 0
         assert 0 < cb["occupancy"] <= 1
+        # the same-window A/B must carry both engine modes, each with
+        # the device-anchored e2e figure
+        for mode in ("dense", "paged"):
+            assert cb[mode]["e2e_tokens_per_s_anchored"] > 0
+            assert cb[mode]["decode_tokens_per_s"] > 0
+            assert cb[mode]["ticks"] > 0 and cb[mode]["waves"] > 0
         assert fam["moe_serving"]["gen_tokens_per_s_e2e"] > 0
         assert fam["t5_serving"]["gen_tokens_per_s_e2e"] > 0
         assert fam["lora"]["step_ms"] > 0

@@ -30,15 +30,20 @@ from kubegpu_tpu.kubemeta.codec import pod_allocation
 from kubegpu_tpu.tpuplugin import MockBackend
 
 
-@pytest.fixture
-def served():
-    """One v4-8 node's CRI server + a raw client, no scheduler."""
+@pytest.fixture(params=["json", "grpc"])
+def served(request):
+    """One v4-8 node's CRI server + a raw client, no scheduler — every
+    protocol/image/shim test runs over BOTH transports (the JSON frame
+    fallback and the real runtime.v1 gRPC endpoint)."""
+    from kubegpu_tpu.crishim.grpcserver import GrpcCriClient, GrpcCriServer
     api = FakeApiServer()
     backend = MockBackend("v4-8")
     runtime = FakeRuntime()
-    server = CriServer(api, backend, backend.discover().node_name,
-                       runtime).start()
-    client = CriClient(server.socket_path)
+    server_cls = CriServer if request.param == "json" else GrpcCriServer
+    client_cls = CriClient if request.param == "json" else GrpcCriClient
+    server = server_cls(api, backend, backend.discover().node_name,
+                        runtime).start()
+    client = client_cls(server.socket_path)
     yield api, backend, runtime, server, client
     client.close()
     server.close()
@@ -169,7 +174,11 @@ class TestRemoteShim:
         """RemoteCriShim.create_container == in-process shim semantics,
         but the allocation env crosses the wire."""
         api, backend, runtime, server, client = served
-        shim = RemoteCriShim(server.socket_path)
+        if isinstance(server, CriServer):
+            shim = RemoteCriShim(server.socket_path)
+        else:
+            from kubegpu_tpu.crishim.grpcserver import GrpcRemoteCriShim
+            shim = GrpcRemoteCriShim(server.socket_path)
         try:
             api.create("Pod", tpu_pod("p", chips=0, command=["noop"]))
             h = shim.create_container(api.get("Pod", "p"))
