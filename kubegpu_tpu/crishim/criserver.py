@@ -333,9 +333,6 @@ class CriVerbs:
             raise CriError(f"no such container {cid!r}")
         return handle
 
-
-# -- client -------------------------------------------------------------
-
     def _cleanup_socket(self) -> None:
         try:
             os.unlink(self.socket_path)
@@ -404,6 +401,8 @@ class CriServer(CriVerbs):
         self._cleanup_socket()
 
 
+
+# -- client -------------------------------------------------------------
 
 class CriClient:
     """Thread-safe frame client: one persistent connection, calls

@@ -843,6 +843,136 @@ def _spec_fused_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
     return run
 
 
+@functools.lru_cache(maxsize=32)
+def _pld_fused_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
+                  gamma: int, ngram: int, kv_int8: bool):
+    """Prompt-lookup (n-gram) speculative decoding, fully on-device.
+
+    The draft source is the sequence ITSELF: propose the γ tokens that
+    followed the most recent earlier occurrence of the current
+    trailing ``ngram``.  No draft model, no draft cache — the entire
+    draft cost is integer compares over the token buffer, so every
+    iteration costs ONE chunked (γ+1) full-model forward; at decode
+    batch sizes that forward is weight-read bound and costs barely
+    more than a single-token step, which is why this wins wherever
+    the text repeats (VERDICT r3 next-item #3: the layer-slice
+    self-draft could never beat greedy on an untrained model — its
+    acceptance was 0 while its draft steps still cost real forwards).
+
+    Emitted tokens are the full model's argmax by construction, same
+    as :func:`spec_generate_fused` (the lookup only decides how many
+    tokens each forward yields, never which) — bit-exact vs greedy in
+    f32, the usual chunked-vs-stepwise bf16 tie caveat applies."""
+    clen = max_len + gamma
+    width = n_steps + gamma + 1
+    seqlen = t + width   # prompt + out view; pos+γ always within it
+    slots = jnp.arange(gamma + 1)
+
+    @jax.jit
+    def run(params, prompt):
+        b = prompt.shape[0]
+        logits, fcache = prefill(params, prompt, cfg, clen,
+                                 kv_int8=kv_int8)
+        cur = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        out = jnp.zeros((b, width), prompt.dtype).at[:, 0].set(cur)
+
+        def lookup(seq, pos):
+            """Latest position i < pos whose ngram-window (ending at i)
+            equals the window ending at pos; returns drafted [B, γ]
+            (continuation after the match; repeats of the current token
+            when no match exists — rarely accepted, statically shaped)."""
+            w = jax.vmap(
+                lambda s: lax.dynamic_slice(s, (pos - ngram + 1,),
+                                            (ngram,)))(seq)   # [B, n]
+            m = jnp.ones(seq.shape, bool)
+            for k in range(ngram):
+                shift = ngram - 1 - k
+                shifted = jnp.pad(seq, ((0, 0), (shift, 0)))[:, :seqlen] \
+                    if shift else seq
+                m &= shifted == w[:, k:k + 1]
+            i = jnp.arange(seqlen)[None, :]
+            cand = (i >= ngram - 1) & (i < pos)
+            i_match = jnp.max(jnp.where(m & cand, i, -1), axis=1)  # [B]
+            found = i_match >= 0
+            start = jnp.maximum(i_match + 1, 0)
+            cont = jax.vmap(
+                lambda s, st: lax.dynamic_slice(s, (st,), (gamma,)))(
+                seq, start)
+            last = jax.vmap(
+                lambda s: lax.dynamic_slice(s, (pos,), (1,)))(seq)
+            return jnp.where(found[:, None], cont,
+                             jnp.broadcast_to(last, cont.shape))
+
+        def cond(c):
+            return c[1] < n_steps
+
+        def body(c):
+            out, n_out, cur, pos, fcache, iters, acc, prop = c
+            # sequence view: prompt then emitted tokens (cur sits at
+            # sequence index pos = t + n_out - 1)
+            seq = jnp.concatenate([prompt, out], axis=1)
+            drafted = lookup(seq, pos)                      # [B, γ]
+            chunk = jnp.concatenate([cur[:, None], drafted], axis=1)
+            vlogits, fcache = _forward_with_cache(params, chunk, fcache,
+                                                  pos, cfg)
+            f = jnp.argmax(vlogits, axis=-1).astype(cur.dtype)
+            match = (drafted == f[:, :gamma]).astype(jnp.int32)
+            # lockstep accept (min over batch).  Unlike the self-draft
+            # path there is NO γ-1 cap: the lookup has no cache to keep
+            # consistent, and when all γ drafts match, f[:, γ] is the
+            # model's own next token — a full γ+1 tokens per forward.
+            j = jnp.cumprod(match, axis=1).sum(axis=1).min()
+            take = jnp.minimum(j, n_steps - n_out - 1)
+            corr = lax.dynamic_index_in_dim(f, take, axis=1,
+                                            keepdims=False)  # [B]
+            padded = jnp.concatenate([drafted, drafted[:, -1:]], axis=1)
+            emit = jnp.where(slots[None, :] < take, padded,
+                             corr[:, None])                  # [B, γ+1]
+            out = lax.dynamic_update_slice(out, emit, (0, n_out))
+            prop_i = jnp.minimum(gamma, n_steps - n_out - 1)
+            return (out, n_out + take + 1, corr, pos + take + 1,
+                    fcache, iters + 1, acc + take, prop + prop_i)
+
+        init = (out, jnp.int32(1), cur, jnp.int32(t), fcache,
+                jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        out, _, _, _, _, iters, acc, prop = lax.while_loop(
+            cond, body, init)
+        return out[:, :n_steps], iters, acc, prop
+
+    return run
+
+
+def pld_generate_fused(params: dict, prompt: jax.Array, n_steps: int,
+                       cfg: LlamaConfig, gamma: int = 8,
+                       ngram: int = 3, max_len: int | None = None,
+                       kv_int8: bool = False
+                       ) -> tuple[jax.Array, dict]:
+    """Prompt-lookup speculative decoding (see :func:`_pld_fused_fn`):
+    draft-model-free, wins wherever the generation revisits n-grams of
+    its own context (templated text, code edits, summarization);
+    degrades to ~greedy cost on non-repetitive text instead of losing
+    like a cold self-draft.  Returns (tokens [B, n_steps], stats)."""
+    t = prompt.shape[1]
+    max_len = _validate_rollout(cfg, t, n_steps, max_len)
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+    toks, iters, acc, prop = _pld_fused_fn(
+        cfg, t, n_steps, max_len, gamma, ngram, kv_int8)(params, prompt)
+    # ONE host fetch for all three counters — three separate int()
+    # casts cost three tunnel round trips (~115 ms each, r4 measured
+    # them dwarfing the generation itself in the bench lambda)
+    import numpy as np
+    iters, acc, prop = (int(x) for x in
+                        np.asarray(jnp.stack([iters, acc, prop])))
+    stats = {
+        "iterations": iters,
+        "acceptance_rate": (acc / prop) if prop else 0.0,
+    }
+    return toks, stats
+
+
 def spec_generate_fused(params: dict, prompt: jax.Array, n_steps: int,
                         cfg: LlamaConfig, draft_layers: int,
                         gamma: int = 4, max_len: int | None = None,
@@ -866,9 +996,12 @@ def spec_generate_fused(params: dict, prompt: jax.Array, n_steps: int,
     toks, iters, acc, prop = _spec_fused_fn(
         cfg, t, n_steps, max_len, draft_layers, gamma, kv_int8)(
         params, dparams, prompt)
-    proposed = int(prop)
+    # ONE host fetch for all three counters (see pld_generate_fused)
+    import numpy as np
+    iters, acc, prop = (int(x) for x in
+                        np.asarray(jnp.stack([iters, acc, prop])))
     stats = {
-        "iterations": int(iters),
-        "acceptance_rate": (int(acc) / proposed) if proposed else 0.0,
+        "iterations": iters,
+        "acceptance_rate": (acc / prop) if prop else 0.0,
     }
     return toks, stats

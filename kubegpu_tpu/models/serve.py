@@ -269,17 +269,7 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
     categorical draw out of the hot scan on its own."""
 
     def _pick(logits, temps, k_):
-        """Per-slot token selection: greedy where temps == 0, else the
-        shared :func:`decode._sample_token` draw (temperature-scaled,
-        top-k-truncated) — the truncation math exists exactly once;
-        only the per-row greedy/sampled blend is this engine's."""
-        greedy = jnp.argmax(logits, axis=-1)
-        if not sampling:
-            return greedy
-        from kubegpu_tpu.models.decode import _sample_token
-        sampled = _sample_token(logits, k_, temps[:, None],
-                                jnp.float32(1.0), top_k, nucleus=False)
-        return jnp.where(temps > 0, sampled, greedy)
+        return _pick_token(logits, temps, k_, top_k, sampling)
 
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def decode_block(params, cache, tokens, pos, active, temps,
@@ -516,21 +506,20 @@ class ContinuousBatcher:
                  max_len: int | None = None, stride: int = 16,
                  prompt_buckets: tuple[int, ...] = (128, 512, 1024),
                  sampling: bool = False, top_k: int = 0, seed: int = 0,
-                 max_wave: int = 1, paged: bool = False,
+                 max_wave: int = 8, paged: bool = False,
                  page_size: int = 128, total_pages: int | None = None):
         if not 0 <= top_k <= cfg.vocab_size:
             raise ValueError(
                 f"top_k {top_k} not in [0, vocab_size={cfg.vocab_size}]")
         self.sampling = sampling
-        # Wave-size cap, DEFAULT 1.  Batched admission (k requests in
-        # one [k, bucket] prefill + one adopt) is implemented and
-        # parity-tested, but on-chip A/B runs were inconclusive: the
-        # tunnel's throughput swung 5x between measurement windows,
-        # and within one window k=1 was never slower (per-request
-        # prefill cost measured flat across k — prefill is
-        # compute-bound at these shapes — while each wave holds a
-        # [k, max_len] cache transient alive).  Raise only with a
-        # trustworthy measurement setup.
+        # Wave-size cap, DEFAULT 8.  The r3 A/B was inconclusive
+        # (tunnel weather swung 5x between windows); the r4 in-window
+        # chained measurement settled it: at flagship shapes a k=8
+        # wave costs 3.66 ms/request (prefill 3.37 + adopt 0.29)
+        # vs 4.04 (1.86 + 2.17) at k=1 — 0.91x, the adopt's fixed
+        # per-dispatch cost amortizing — plus 2 dispatches per wave
+        # instead of 2k.  Each wave still holds a [k, bucket] prefill
+        # panel transient; cap at 1 on HBM-critical configs.
         self.max_wave = max(1, max_wave)
         self.params = params
         self.cfg = cfg
@@ -577,6 +566,12 @@ class ContinuousBatcher:
             self._tvec = np.zeros((n_slots,), np.int32)
             self._tpad = np.zeros((n_slots,), np.int32)
             self._slot_pages: dict[int, list[int]] = {}
+            # device-resident copies, re-uploaded only when admission/
+            # retirement actually mutates them — uploading three arrays
+            # per tick measured ~ms each of dispatch latency under the
+            # TPU tunnel (steady-state decode ticks touch none of them)
+            self._tables_dirty = True
+            self._pt_dev = self._tvec_dev = self._tpad_dev = None
         else:
             self._fns = _engine_fns(cfg, n_slots, self.max_len, stride,
                                     top_k, sampling)
@@ -608,6 +603,7 @@ class ContinuousBatcher:
         self._decode_tokens = 0      # tokens produced BY decode steps
         self.slot_steps = 0          # decode slot-steps spent
         self.prefill_waves = 0       # admission waves dispatched
+        self.wave_sizes: list[int] = []   # k of each dispatched wave
 
     def warmup(self) -> None:
         """Compile every executable this engine can hit — the decode
@@ -775,6 +771,7 @@ class ContinuousBatcher:
                 self.params, padded, true_lens, temps_w,
                 self._base_key, jnp.int32(wave[0][0].rid))
             self.prefill_waves += 1
+            self.wave_sizes.append(k)
             # two dispatches per WAVE, zero host fetches: first-token
             # values reach req.tokens at the next tick's fused fetch
             if self.paged:
@@ -789,6 +786,7 @@ class ContinuousBatcher:
                     self._pt[slot, :need] = pages
                     self._tvec[slot] = req.prompt_len
                     self._tpad[slot] = bucket
+                    self._tables_dirty = True
                     page_dst[i] = pages[:n_prompt_pages]
                 (self.pool, self.first_toks, self.tokens,
                  self.pos, self.temps) = adopt_wave(
@@ -825,12 +823,17 @@ class ContinuousBatcher:
         self._admit()
         if self.slot_req:
             if self.paged:
-                # the page table and per-row length scalars ride the
-                # block dispatch as tiny int32 uploads — retirement and
-                # admission mutate them host-side for free
+                # page table + per-row length scalars are device-
+                # resident and re-uploaded only after admission/
+                # retirement mutated them host-side
+                if self._tables_dirty:
+                    self._pt_dev = jnp.asarray(self._pt)
+                    self._tvec_dev = jnp.asarray(self._tvec)
+                    self._tpad_dev = jnp.asarray(self._tpad)
+                    self._tables_dirty = False
                 block, self.tokens, self.pos, self.pool = decode_block(
-                    self.params, self.pool, jnp.asarray(self._pt),
-                    jnp.asarray(self._tvec), jnp.asarray(self._tpad),
+                    self.params, self.pool, self._pt_dev,
+                    self._tvec_dev, self._tpad_dev,
                     self.tokens, self.pos, jnp.asarray(self.active),
                     self.temps, self._base_key, jnp.int32(self._tick))
             else:
@@ -889,6 +892,7 @@ class ContinuousBatcher:
         self._pt[slot, :] = 0
         self._tvec[slot] = 0
         self._tpad[slot] = 0
+        self._tables_dirty = True
 
     def drain(self, max_ticks: int = 10_000) -> list[_Request]:
         """Run until queue and slots are empty; returns every finished

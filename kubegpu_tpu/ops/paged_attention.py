@@ -218,9 +218,11 @@ def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Paged decode attention over the pool (one layer), via the page
     table.  Same signature/partials as :func:`paged_attention_ref`;
-    each grid step DMAs exactly one pool page — short rows re-read a
-    clamped page id that the validity mask zeroes, and nothing like a
-    ``[B, S, D]`` gather is ever materialized."""
+    one grid program per row walks that row's used pages with manual
+    double-buffered DMAs (see :func:`_paged_kernel`), so reads scale
+    with what rows actually hold and nothing like a ``[B, S, D]``
+    gather is ever materialized.  Empty rows (t = d = 0) run a single
+    fully-masked iteration and emit zeros."""
     b, hq, dd = q.shape
     n_layers, n_pages_total, hkv, p, _ = pool_k.shape
     max_pages = page_table.shape[1]

@@ -33,7 +33,7 @@ class TestModelBench:
         # tiny CPU path so a missing row fails before a hardware run
         fam = out["families"]
         assert set(fam) == {"moe_serving", "t5_serving", "lora",
-                            "beam", "spec_decode",
+                            "beam", "spec_decode", "spec_decode_pld",
                             "continuous_batching"}
         cb = fam["continuous_batching"]
         assert cb["e2e_tokens_per_s_anchored"] > 0

@@ -410,3 +410,47 @@ class TestSpeculative:
                                 dparams=dview)
         greedy = np.asarray(greedy_generate(qparams, prompt, 4, cfg))
         np.testing.assert_array_equal(np.asarray(toks), greedy)
+
+
+class TestPromptLookup:
+    """Prompt-lookup (n-gram) speculative decoding: draft-model-free,
+    bit-exact with greedy in f32 regardless of acceptance."""
+
+    def test_exact_on_repetitive_prompt(self, tiny):
+        cfg, params = tiny
+        import numpy as np
+
+        from kubegpu_tpu.models.decode import pld_generate_fused
+        pat = np.asarray([3, 7, 11, 5, 2, 9, 4, 8])
+        prompt = jnp.asarray(np.tile(pat, 4)[None].repeat(2, 0),
+                             jnp.int32)
+        g = greedy_generate(params, prompt, 24, cfg, max_len=128)
+        p, stats = pld_generate_fused(params, prompt, 24, cfg,
+                                      gamma=6, ngram=3, max_len=128)
+        assert (np.asarray(g) == np.asarray(p)).all()
+        assert stats["iterations"] >= 1
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+    def test_exact_on_nonrepetitive_prompt(self, tiny):
+        cfg, params = tiny
+        import numpy as np
+
+        from kubegpu_tpu.models.decode import pld_generate_fused
+        prompt = jnp.asarray(
+            (np.arange(40)[None].repeat(2, 0) * 37 + 11)
+            % cfg.vocab_size, jnp.int32)
+        g = greedy_generate(params, prompt, 12, cfg, max_len=128)
+        p, stats = pld_generate_fused(params, prompt, 12, cfg,
+                                      gamma=4, ngram=3, max_len=128)
+        assert (np.asarray(g) == np.asarray(p)).all()
+
+    def test_validation(self, tiny):
+        cfg, params = tiny
+        import pytest as _pytest
+
+        from kubegpu_tpu.models.decode import pld_generate_fused
+        prompt = jnp.zeros((1, 8), jnp.int32)
+        with _pytest.raises(ValueError, match="gamma"):
+            pld_generate_fused(params, prompt, 4, cfg, gamma=0)
+        with _pytest.raises(ValueError, match="ngram"):
+            pld_generate_fused(params, prompt, 4, cfg, ngram=0)
