@@ -387,7 +387,7 @@ def _families_bench(cfg, params, on_tpu) -> dict:
     lora_step = jax.jit(make_lora_train_step(cfg, lcfg, opt),
                        donate_argnums=(0, 1))
     toks = jnp.asarray(
-        np.arange(lora_batch * (seq + 1)).reshape(lora_batch, seq + 1)
+        np.arange(lora_batch * seq).reshape(lora_batch, seq)
         % cfg.vocab_size, jnp.int32)
     lora_s, _ = _time_chained(
         lambda s: lora_step(s[0], s[1], params, toks),
@@ -696,7 +696,7 @@ def run_model_bench(steps: int = 12) -> dict:
     # this model size that alone OOMs a 16 GiB chip
     step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
     tokens = jnp.asarray(
-        (np.arange(batch * (seq + 1)).reshape(batch, seq + 1))
+        (np.arange(batch * seq).reshape(batch, seq))
         % cfg.vocab_size, jnp.int32)
 
     # timed as one chained burst (params flow step-to-step, so nothing

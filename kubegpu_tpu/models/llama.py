@@ -260,8 +260,15 @@ def _gqa_wrap(ring_fn, cfg: LlamaConfig):
 
 def next_token_loss(params: dict, tokens: jax.Array, cfg: LlamaConfig,
                     mesh: Mesh | None = None) -> jax.Array:
-    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
-    logits = llama_forward(params, tokens[:, :-1], cfg, mesh)
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1].
+
+    The forward runs on ALL T tokens and the last position's logits
+    are dropped, rather than slicing the input to T-1: causality makes
+    the first T-1 positions' logits identical either way, but T-1
+    (e.g. 2047) breaks every kernel/MXU tile alignment — the r4
+    profiler trace caught the T=2047 forward silently falling back to
+    O(T²)-materializing XLA attention for the entire train step."""
+    logits = llama_forward(params, tokens, cfg, mesh)[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

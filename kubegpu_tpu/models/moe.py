@@ -257,7 +257,11 @@ def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
 
 def moe_next_token_loss(params: dict, tokens: jax.Array, cfg: MoEConfig,
                         mesh: Mesh | None = None) -> jax.Array:
-    logits, aux = moe_forward(params, tokens[:, :-1], cfg, mesh)
+    # forward ALL T tokens and drop the last logit (same contract as
+    # llama's next_token_loss r4 fix): a T-1 forward breaks kernel
+    # block alignment and silently fell back to O(T^2) XLA attention
+    logits, aux = moe_forward(params, tokens, cfg, mesh)
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

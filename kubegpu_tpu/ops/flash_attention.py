@@ -75,6 +75,25 @@ LSE_LANES = 8
 DKV_PANEL_BUDGET = 6 * 1024 * 1024
 
 
+_warned_fallback: set = set()
+
+
+def _warn_fallback_once(t: int, s: int, block_q: int, block_k: int) -> None:
+    """A LOUD (once per shape) note when block alignment silently
+    routes to the XLA path: the r4 profiler trace caught the flagship
+    train step running O(T²) XLA attention for two whole rounds
+    because its loss sliced T to 2047 — a silent fallback on the hot
+    path must never be silent again."""
+    key = (t, s, block_q, block_k)
+    if key in _warned_fallback:
+        return
+    _warned_fallback.add(key)
+    import sys
+    print(f"flash_attention: shape (t={t}, s={s}) not divisible by "
+          f"blocks ({block_q}, {block_k}) — falling back to XLA "
+          "attention (O(T²) scores materialized)", file=sys.stderr)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret", "return_lse"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -111,6 +130,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     block_q = min(block_q, t)
     block_k = min(block_k, s)
     if t % block_q or s % block_k:
+        _warn_fallback_once(t, s, block_q, block_k)
         out = xla_attention(q, k, v, causal=causal)
         if not return_lse:
             return out

@@ -162,15 +162,17 @@ def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, n_microbatches: int):
     tok_spec = fit_spec(mesh, P("dp", None))
 
     def local_loss(params, tokens):
-        # tokens: dp-local [b, T+1]
-        b, t1 = tokens.shape
+        # tokens: dp-local [b, T] — the forward runs on ALL T (kernel
+        # block alignment; same all-T contract as next_token_loss) and
+        # the last position's logits are dropped from the loss
+        b, t = tokens.shape
         if b % n_microbatches:
             raise ValueError(
                 f"local batch {b} % microbatches {n_microbatches} != 0")
         mb = b // n_microbatches
-        t = t1 - 1
-        inp = tokens[:, :-1].reshape(n_microbatches, mb, t)
-        tgt = tokens[:, 1:].reshape(n_microbatches, mb, t)
+        inp = tokens.reshape(n_microbatches, mb, t)
+        tgt = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1))
+                      ).reshape(n_microbatches, mb, t)
         x = jnp.take(params["embed"], inp, axis=0)      # [M, mb, T, d]
         positions = jnp.broadcast_to(
             jnp.arange(t, dtype=jnp.int32), (mb, t))
@@ -188,7 +190,11 @@ def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, n_microbatches: int):
         logits = (h @ params["lm_head"]).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-        loss = -ll.mean()
+        # the last position has no next token (its padded target is 0):
+        # exclude it from the mean
+        valid = jnp.arange(t) < t - 1
+        loss = -(ll * valid).sum() / (valid.sum() * ll.shape[0]
+                                      * ll.shape[1])
         # outputs (hence loss) are valid on the last pp rank only
         loss = lax.psum(
             jnp.where(lax.axis_index("pp") == pp - 1, loss, 0.0), "pp")
