@@ -92,3 +92,16 @@ class TestFullBench:
         out = run_full_bench(n_gangs=4, seed=2)
         assert out["value"] > 0
         assert out["details"]["model"] == {"error": "chip"}
+
+
+def test_multislice_bench_crosses_dcn():
+    """The multislice scale scenario must actually exercise DCN-spanning
+    gangs: some placed gangs land on >1 slice and the bench reports the
+    fraction (VERDICT r3 next-item #8's done bar)."""
+    from kubegpu_tpu.benchmark import run_multislice_bench
+    out = run_multislice_bench(n_gangs=40, seed=0)
+    d = out["details"]
+    assert d["gangs_multislice"] >= 1
+    assert 0 < d["multislice_fraction"] <= 1
+    assert d["mean_allocation_locality"] > 0.8
+    assert out["value"] >= 0
