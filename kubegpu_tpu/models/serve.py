@@ -183,6 +183,8 @@ def _paged_row_step(params: dict, tokens: jax.Array, pool: dict,
     x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]   # [B,1,D]
     positions = pos[:, None]
     pool_k, pool_v = pool["k"], pool["v"]
+    k_scale = pool.get("k_scale")
+    v_scale = pool.get("v_scale")
 
     def layer(x, xs):
         lp, bk, bv, li = xs
@@ -194,7 +196,7 @@ def _paged_row_step(params: dict, tokens: jax.Array, pool: dict,
                                       (0, 0, j, 0))
         o_p, m_p, l_p = paged_attention(
             q[:, :, 0, :], pool_k, pool_v, pt, li, tvec, tpad, d0,
-            interpret=interpret)
+            k_scale=k_scale, v_scale=v_scale, interpret=interpret)
         o_b, m_b, l_b = _attend_buffer_partials(q, bk, bv, j)
         o = merge_partials(o_p, m_p, l_p, o_b, m_b, l_b)
         o = o[:, :, None, :].astype(x.dtype)            # [B,Hq,1,D]
@@ -227,16 +229,53 @@ def _flush_buffer_paged(pool: dict, buf: dict, pt: jax.Array,
     page = jnp.take_along_axis(pt, pidx[:, None], axis=1)[:, 0]   # [B]
     off = phys0 % page_size
 
-    def write_row(b, pool_kv):
-        pk, pv = pool_kv
+    quant = "k_scale" in pool
+    if quant:
+        # ONE vectorized quantize of the whole buffer; the per-slot
+        # loop below only scatters (a review catch: quantizing inside
+        # the sequential loop serialized n_slots quantize ops on the
+        # hot decode path)
+        from kubegpu_tpu.models.decode import _quantize_rows
+        kq, ksc = _quantize_rows(
+            buf["k"].reshape((-1,) + buf["k"].shape[2:]))
+        vq, vsc = _quantize_rows(
+            buf["v"].reshape((-1,) + buf["v"].shape[2:]))
+        qbuf = {"k": kq.reshape(buf["k"].shape),
+                "v": vq.reshape(buf["v"].shape),
+                "k_scale": ksc.reshape(buf["k"].shape[:-1]),
+                "v_scale": vsc.reshape(buf["v"].shape[:-1])}
+
+    def write_row(b, pool_st):
         # [L, 1, Hkv, stride, D] → pool at (layer *, page, head *, off, *)
+        start = (0, page[b], 0, off[b], 0)
+        if quant:
+            pk, pv, pks, pvs = pool_st
+            s4 = (0, page[b], 0, off[b])
+            pk = lax.dynamic_update_slice(
+                pk, lax.dynamic_slice_in_dim(qbuf["k"], b, 1, axis=1),
+                start)
+            pv = lax.dynamic_update_slice(
+                pv, lax.dynamic_slice_in_dim(qbuf["v"], b, 1, axis=1),
+                start)
+            pks = lax.dynamic_update_slice(
+                pks, lax.dynamic_slice_in_dim(qbuf["k_scale"], b, 1,
+                                              axis=1), s4)
+            pvs = lax.dynamic_update_slice(
+                pvs, lax.dynamic_slice_in_dim(qbuf["v_scale"], b, 1,
+                                              axis=1), s4)
+            return pk, pv, pks, pvs
+        pk, pv = pool_st
         seg_k = lax.dynamic_slice_in_dim(buf["k"], b, 1, axis=1)
         seg_v = lax.dynamic_slice_in_dim(buf["v"], b, 1, axis=1)
-        start = (0, page[b], 0, off[b], 0)
         pk = lax.dynamic_update_slice(pk, seg_k.astype(pk.dtype), start)
         pv = lax.dynamic_update_slice(pv, seg_v.astype(pv.dtype), start)
         return pk, pv
 
+    if quant:
+        pk, pv, pks, pvs = lax.fori_loop(
+            0, n_slots, write_row,
+            (pool["k"], pool["v"], pool["k_scale"], pool["v_scale"]))
+        return {"k": pk, "v": pv, "k_scale": pks, "v_scale": pvs}
     pk, pv = lax.fori_loop(
         0, n_slots, write_row, (pool["k"], pool["v"]))
     return {"k": pk, "v": pv}
@@ -374,7 +413,8 @@ def _pick_token(logits, temps, k_, top_k: int, sampling: bool):
 @functools.lru_cache(maxsize=32)
 def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                       page_size: int, stride: int, top_k: int = 0,
-                      sampling: bool = False, interpret: bool = False):
+                      sampling: bool = False, interpret: bool = False,
+                      kv_int8: bool = False):
     """Jitted engine pieces for the PAGED cache mode: the KV history
     lives in a page pool [L, n_pages, Hkv, P, D] shared by all slots
     (page 0 is a trash page, never allocated), addressed through a
@@ -402,8 +442,11 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             stride)
         d0 = jnp.where(active, pos - tvec, 0)
         shape = pool["k"].shape            # [L, n_pages, Hkv, P, D]
+        # the write buffer stays in the MODEL dtype regardless of the
+        # pool's (int8 pools quantize at flush, not at write — the
+        # in-block keys are attended exactly)
         buf = {n: jnp.zeros((shape[0], n_slots, shape[2], stride,
-                             shape[4]), pool[n].dtype)
+                             shape[4]), cfg.jdtype)
                for n in ("k", "v")}
 
         def step(carry, xs):
@@ -450,19 +493,45 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         donated pool."""
         bucket = cache_w["k"].shape[3]
         n_pages_row = bucket // page_size
+        if kv_int8:
+            from kubegpu_tpu.models.decode import _quantize_rows
+            kq, ksc = _quantize_rows(
+                cache_w["k"].reshape((-1,) + cache_w["k"].shape[2:]))
+            vq, vsc = _quantize_rows(
+                cache_w["v"].reshape((-1,) + cache_w["v"].shape[2:]))
+            cache_q = {
+                "k": kq.reshape(cache_w["k"].shape),
+                "v": vq.reshape(cache_w["v"].shape),
+                "k_scale": ksc.reshape(cache_w["k"].shape[:-1]),
+                "v_scale": vsc.reshape(cache_w["v"].shape[:-1]),
+            }
         for i in range(k):
             for pi in range(n_pages_row):
-                src_k = cache_w["k"][:, i:i + 1, :,
-                                     pi * page_size:(pi + 1) * page_size]
-                src_v = cache_w["v"][:, i:i + 1, :,
-                                     pi * page_size:(pi + 1) * page_size]
+                sl = (slice(None), slice(i, i + 1), slice(None),
+                      slice(pi * page_size, (pi + 1) * page_size))
                 start = (0, page_dst[i, pi], 0, 0, 0)
-                pool = {
-                    "k": lax.dynamic_update_slice(
-                        pool["k"], src_k.astype(pool["k"].dtype), start),
-                    "v": lax.dynamic_update_slice(
-                        pool["v"], src_v.astype(pool["v"].dtype), start),
-                }
+                if kv_int8:
+                    pool = {
+                        "k": lax.dynamic_update_slice(
+                            pool["k"], cache_q["k"][sl], start),
+                        "v": lax.dynamic_update_slice(
+                            pool["v"], cache_q["v"][sl], start),
+                        "k_scale": lax.dynamic_update_slice(
+                            pool["k_scale"], cache_q["k_scale"][sl],
+                            start[:-1]),
+                        "v_scale": lax.dynamic_update_slice(
+                            pool["v_scale"], cache_q["v_scale"][sl],
+                            start[:-1]),
+                    }
+                else:
+                    src_k = cache_w["k"][sl]
+                    src_v = cache_w["v"][sl]
+                    pool = {
+                        "k": lax.dynamic_update_slice(
+                            pool["k"], src_k.astype(pool["k"].dtype), start),
+                        "v": lax.dynamic_update_slice(
+                            pool["v"], src_v.astype(pool["v"].dtype), start),
+                    }
             first_toks = lax.dynamic_update_slice(
                 first_toks, firsts[i:i + 1], (slots[i],))
             tokens = lax.dynamic_update_slice(
@@ -507,7 +576,8 @@ class ContinuousBatcher:
                  prompt_buckets: tuple[int, ...] = (128, 512, 1024),
                  sampling: bool = False, top_k: int = 0, seed: int = 0,
                  max_wave: int = 8, paged: bool = False,
-                 page_size: int = 128, total_pages: int | None = None):
+                 page_size: int = 128, total_pages: int | None = None,
+                 kv_int8: bool = False):
         if not 0 <= top_k <= cfg.vocab_size:
             raise ValueError(
                 f"top_k {top_k} not in [0, vocab_size={cfg.vocab_size}]")
@@ -530,6 +600,10 @@ class ContinuousBatcher:
         if self.prompt_buckets[-1] >= self.max_len:
             raise ValueError("largest prompt bucket must be < max_len")
         self.paged = paged
+        if kv_int8 and not paged:
+            raise ValueError(
+                "kv_int8=True requires paged=True (the dense engine's "
+                "int8 cache is the static decode path's kv_int8)")
         if paged:
             from kubegpu_tpu.ops.paged_attention import page_table_size
             if page_size % stride:
@@ -556,11 +630,21 @@ class ContinuousBatcher:
             interpret = jax.devices()[0].platform == "cpu"
             self._fns = _paged_engine_fns(
                 cfg, n_slots, self.max_pages, page_size, stride, top_k,
-                sampling, interpret)
+                sampling, interpret, kv_int8)
             shape = (cfg.n_layers, self.total_pages + 1, cfg.n_kv_heads,
                      page_size, cfg.head_dim)
-            self.pool = {"k": jnp.zeros(shape, cfg.jdtype),
-                         "v": jnp.zeros(shape, cfg.jdtype)}
+            if kv_int8:
+                # int8 pages with per-token f32 scales — the cache
+                # streams at half the bytes (the dense engine's r2
+                # wide-batch lever, now paged); scales init to 1 so
+                # unwritten entries dequantize to exact zero
+                self.pool = {"k": jnp.zeros(shape, jnp.int8),
+                             "v": jnp.zeros(shape, jnp.int8),
+                             "k_scale": jnp.ones(shape[:-1], jnp.float32),
+                             "v_scale": jnp.ones(shape[:-1], jnp.float32)}
+            else:
+                self.pool = {"k": jnp.zeros(shape, cfg.jdtype),
+                             "v": jnp.zeros(shape, cfg.jdtype)}
             self._free_pages = list(range(1, self.total_pages + 1))
             self._pt = np.zeros((n_slots, self.max_pages), np.int32)
             self._tvec = np.zeros((n_slots,), np.int32)

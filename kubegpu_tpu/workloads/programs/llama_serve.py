@@ -162,10 +162,18 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
     # doesn't align to a page (tiny smoke configs).
     page_size = 128
     paged = prompt_t % page_size == 0 and page_size % stride == 0
+    # int8 KV pages only at the scale where the cache out-reads the
+    # weights: r4 in-window A/B measured 1.11x at 32 slots x 1024
+    # prompt but 0.80x at 8 x 512 (quantize-at-flush + in-kernel casts
+    # outweigh the byte savings on small caches)
+    kv_int8 = paged and n_slots * prompt_t >= 16384
+    if os.environ.get("SERVE_KV_INT8") is not None:
+        kv_int8 = paged and os.environ["SERVE_KV_INT8"] == "1"
     eng = ContinuousBatcher(params, cfg, n_slots=n_slots,
                             max_len=max_len, stride=stride,
                             prompt_buckets=(prompt_t,),
-                            paged=paged, page_size=page_size)
+                            paged=paged, page_size=page_size,
+                            kv_int8=kv_int8)
     # compile every wave size + the decode block OUTSIDE the timed
     # window; warmup() is state-free, so the occupancy gauge stays
     # pure steady state
