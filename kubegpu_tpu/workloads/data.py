@@ -171,10 +171,14 @@ def global_batches(it: Iterable, mesh, spec) -> Iterator:
 
 def synthetic_tokens(n: int, seq_len: int, vocab_size: int,
                      seed: int = 0) -> dict[str, np.ndarray]:
-    """Deterministic synthetic causal-LM dataset ({'tokens': [n, T+1]})."""
+    """Deterministic synthetic causal-LM dataset ({'tokens': [n, T]}).
+
+    All-T loss contract: the train step forwards the full [B, T] and
+    computes next-token loss on T-1 positions internally — examples are
+    exactly ``seq_len`` long so kernel block alignment survives."""
     rng = np.random.default_rng(seed)
     return {"tokens": rng.integers(
-        0, vocab_size, (n, seq_len + 1), dtype=np.int32)}
+        0, vocab_size, (n, seq_len), dtype=np.int32)}
 
 
 def synthetic_images(n: int, size: int, n_classes: int,

@@ -108,7 +108,7 @@ def main() -> int:
     step_fn = jax.jit(make_train_step(cfg, opt, mesh),
                       donate_argnums=(0, 1))
     batch = max(2, axes.get("dp", 1) * axes.get("fsdp", 1))
-    seq = 33
+    seq = 32  # all-T loss contract: tokens are [B, T], T tile-aligned
     tok_sharding = NamedSharding(mesh, fit_spec(mesh, P(("dp", "fsdp"),
                                                         None)))
     profile_dir = os.environ.get("LLAMA_PROFILE_DIR")
