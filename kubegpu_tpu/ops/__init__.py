@@ -7,5 +7,7 @@ CPU (tests) and as a numerics reference.
 """
 
 from kubegpu_tpu.ops.flash_attention import attention, flash_attention, xla_attention
+from kubegpu_tpu.ops.strict import StrictFallbackError, require_pallas
 
-__all__ = ["attention", "flash_attention", "xla_attention"]
+__all__ = ["attention", "flash_attention", "xla_attention",
+           "StrictFallbackError", "require_pallas"]

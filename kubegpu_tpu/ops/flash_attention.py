@@ -73,9 +73,29 @@ LSE_LANES = 8
 # kernel (see flash_attention_bwd): beyond this the geometry de-groups
 # via repeat_kv instead of risking a scoped-vmem compile error.
 DKV_PANEL_BUDGET = 6 * 1024 * 1024
+# Grouped-dkv q-block cap (VMEM: resident panels + 512-tall score
+# scratch overflowed the 16 MiB scoped limit at 512 — see
+# flash_attention_bwd); module-level so the bwd-profile experiment can
+# sweep it.
+DKV_GROUPED_BQ_CAP = 256
 
 
 _warned_fallback: set = set()
+
+
+def _blocks_ok(t: int, s: int, block_q: int, block_k: int,
+               interpret: bool = False) -> bool:
+    """Whether (clamped) blocks can take the pallas path: they must
+    tile (t, s) exactly AND — on the compiled path — be sublane-aligned
+    (%8): the kernels slice k/v panels and the dkv q-panel on the
+    second-minor dim, so an off-8 block (e.g. t=33 → block 33, which
+    *does* divide) would hand Mosaic a misaligned window (ADVICE r4
+    medium).  Interpret mode has no tiling hardware to violate."""
+    if t % block_q or s % block_k:
+        return False
+    if interpret:
+        return True
+    return block_q % 8 == 0 and block_k % 8 == 0
 
 
 def _warn_fallback_once(t: int, s: int, block_q: int, block_k: int) -> None:
@@ -83,7 +103,12 @@ def _warn_fallback_once(t: int, s: int, block_q: int, block_k: int) -> None:
     routes to the XLA path: the r4 profiler trace caught the flagship
     train step running O(T²) XLA attention for two whole rounds
     because its loss sliced T to 2047 — a silent fallback on the hot
-    path must never be silent again."""
+    path must never be silent again.  Under KUBETPU_REQUIRE_PALLAS
+    the fallback RAISES instead (VERDICT r4 next-item #3)."""
+    from kubegpu_tpu.ops.strict import fallback
+    fallback("flash_attention",
+             f"shape (t={t}, s={s}) does not tile aligned blocks "
+             f"({block_q}, {block_k}); XLA O(T²) attention would run")
     key = (t, s, block_q, block_k)
     if key in _warned_fallback:
         return
@@ -129,7 +154,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     causal_offset = s - t  # end-aligned, matching xla_attention
     block_q = min(block_q, t)
     block_k = min(block_k, s)
-    if t % block_q or s % block_k:
+    if not _blocks_ok(t, s, block_q, block_k, interpret):
         _warn_fallback_once(t, s, block_q, block_k)
         out = xla_attention(q, k, v, causal=causal)
         if not return_lse:
@@ -271,7 +296,8 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
     causal_offset = s - t
     block_q = min(block_q, t)
     block_k = min(block_k, s)
-    assert t % block_q == 0 and s % block_k == 0
+    assert _blocks_ok(t, s, block_q, block_k, interpret), \
+        f"bwd blocks ({block_q},{block_k}) must tile+align (t={t}, s={s})"
     num_k_blocks = s // block_k
     num_q_blocks = t // block_q
     # Geometries whose resident [group·t, d] panels can't fit the dkv
@@ -291,7 +317,8 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
     # 16.28M > 16.00M), so its q-block caps at 256 when grouped —
     # gcd against t so an arbitrary caller block (e.g. 384) can never
     # truncate rows out of the dk/dv accumulation.
-    block_q_kv = math.gcd(t, min(block_q, 256)) if group_kv > 1 else block_q
+    block_q_kv = (math.gcd(t, min(block_q, DKV_GROUPED_BQ_CAP))
+                  if group_kv > 1 else block_q)
     num_q_blocks_kv = t // block_q_kv
 
     qf = q.reshape(b * h, t, d)
@@ -496,7 +523,7 @@ def _bwd_blocks(t: int, s: int) -> tuple[int, int]:
 
 def _flash_diff_fwd(q, k, v, causal, interpret):
     t, s = q.shape[2], k.shape[2]
-    if t % min(BLOCK_Q, t) or s % min(BLOCK_K, s):
+    if not _blocks_ok(t, s, min(BLOCK_Q, t), min(BLOCK_K, s), interpret):
         # fallback shapes: no lse; bwd re-derives through XLA
         return (flash_attention(q, k, v, causal=causal,
                                 interpret=interpret),

@@ -162,6 +162,15 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
     # doesn't align to a page (tiny smoke configs).
     page_size = 128
     paged = prompt_t % page_size == 0 and page_size % stride == 0
+    if not paged:
+        # strict mode (KUBETPU_REQUIRE_PALLAS=1) forbids this silent
+        # paged→dense degradation: a bench/flagship run must abort
+        # rather than attribute dense-engine throughput to the pool
+        from kubegpu_tpu.ops.strict import fallback
+        fallback("llama_serve.continuous",
+                 f"prompt bucket {prompt_t} / stride {stride} does not "
+                 f"align to page_size {page_size}; dense engine would "
+                 "serve instead of the paged pool")
     # int8 KV pages only at the scale where the cache out-reads the
     # weights: r4 in-window A/B measured 1.11x at 32 slots x 1024
     # prompt but 0.80x at 8 x 512 (quantize-at-flush + in-kernel casts

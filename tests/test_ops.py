@@ -328,3 +328,61 @@ class TestFlashBackward:
         for g, r in zip(got, ref):
             np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                        atol=5e-4, rtol=5e-4)
+
+
+class TestStrictMode:
+    """KUBETPU_REQUIRE_PALLAS fences the silent-fallback class that
+    poisoned r1-r3 MFU attribution (VERDICT r4 next-item #3): a hot
+    path degrading to XLA O(T²) attention must RAISE, not warn."""
+
+    def test_blocks_ok_gate(self):
+        from kubegpu_tpu.ops.flash_attention import _blocks_ok
+        # the ADVICE r4 medium case: t=33 divides its own clamped block
+        # but is not sublane-aligned — compiled path must refuse
+        assert not _blocks_ok(33, 33, 33, 33, interpret=False)
+        assert _blocks_ok(33, 33, 33, 33, interpret=True)
+        assert _blocks_ok(2048, 2048, 256, 512, interpret=False)
+        assert not _blocks_ok(2047, 2047, 256, 512, interpret=False)
+        assert _blocks_ok(32, 32, 32, 32, interpret=False)
+
+    def test_strict_raises_on_fallback_shape(self, monkeypatch):
+        from kubegpu_tpu.ops import StrictFallbackError
+        monkeypatch.setenv("KUBETPU_REQUIRE_PALLAS", "1")
+        q, k, v = rand_qkv(jax.random.PRNGKey(8), t=321, s=321)
+        with pytest.raises(StrictFallbackError):
+            flash_attention(q, k, v, causal=True, interpret=True)
+
+    def test_non_strict_still_degrades(self, monkeypatch):
+        monkeypatch.delenv("KUBETPU_REQUIRE_PALLAS", raising=False)
+        q, k, v = rand_qkv(jax.random.PRNGKey(9), t=322, s=322)
+        ref = xla_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_train_step_bench_shape_zero_fallbacks(self, monkeypatch):
+        """The r1-r3 bug class, reproduced then fenced: the flagship
+        train step at the bench sequence length must trace with ZERO
+        attention fallbacks under strict mode (eval_shape runs the
+        trace-time gates without needing a TPU), and the T-1 shape that
+        silently ran O(T²) for three rounds must now fail loudly."""
+        import optax
+
+        from kubegpu_tpu.models import LlamaConfig, llama_init
+        from kubegpu_tpu.models.llama import make_train_step
+        from kubegpu_tpu.ops import StrictFallbackError
+
+        monkeypatch.setenv("KUBETPU_REQUIRE_PALLAS", "1")
+        cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=2,
+                               attn_impl="pallas", max_seq_len=4096)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        opt = optax.sgd(1e-3)
+        opt_state = opt.init(params)
+        step = make_train_step(cfg, opt)
+
+        good = jax.ShapeDtypeStruct((2, 2048), jnp.int32)
+        jax.eval_shape(step, params, opt_state, good)  # must not raise
+
+        bad = jax.ShapeDtypeStruct((2, 2047), jnp.int32)  # the r1-r3 shape
+        with pytest.raises(StrictFallbackError):
+            jax.eval_shape(step, params, opt_state, bad)
