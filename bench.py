@@ -1,8 +1,25 @@
-"""KubeTPU benchmark entry point: gang-schedule p50 latency.
+"""KubeTPU benchmark entry point: gang-schedule p50 latency + headlines.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-The benchmark itself lives in kubegpu_tpu/benchmark.py (shared with the
-``kubetpu bench`` CLI verb); this file is the driver's stable entry point.
+Output contract (VERDICT r4 next-item #1 — the driver's capture window
+is a ~2000-char stdout tail plus a parse of what it finds there, and for
+two rounds the one giant JSON line truncated mid-document, losing the
+flagship MFU/decode numbers from the record):
+
+  stdout line 1: the FULL bench document (one JSON line, large)
+  stdout line 2 (FINAL): a compact headline summary, < ~1500 bytes,
+      guaranteed to sit whole inside the tail window and to parse on
+      its own — metric/p50/vs_baseline, train MFU, flash speedup,
+      decode ladder, continuous-batching A/B, PLD, scheduler scale.
+
+The full document is also written to BENCH_DETAILS.json next to this
+file.  The benchmark itself lives in kubegpu_tpu/benchmark.py (shared
+with the ``kubetpu bench`` CLI verb).
+
+Strict-mode fence (VERDICT r4 next-item #3): the bench exports
+KUBETPU_REQUIRE_PALLAS=1 so any silent hot-path fallback (pallas→XLA
+attention, paged→dense engine) ABORTS the run instead of recording a
+plausible-but-degraded number — the r1-r3 MFU misattribution class.
+Set KUBETPU_REQUIRE_PALLAS=0 explicitly to run permissive.
 """
 
 from __future__ import annotations
@@ -12,7 +29,26 @@ import os
 import sys
 
 if __name__ == "__main__":
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from kubegpu_tpu.benchmark import run_full_bench
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    os.environ.setdefault("KUBETPU_REQUIRE_PALLAS", "1")
+    from kubegpu_tpu.benchmark import run_full_bench, summarize_bench
     n = int(os.environ.get("BENCH_GANGS", "60"))
-    print(json.dumps(run_full_bench(n_gangs=n)))
+    out = run_full_bench(n_gangs=n)
+    full = json.dumps(out)
+    try:
+        with open(os.path.join(repo, "BENCH_DETAILS.json"), "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass   # a read-only checkout must not sink the record
+    print(full)
+    s = summarize_bench(out)
+    summary = json.dumps(s)
+    if len(summary) > 1800:   # belt-and-braces: never outgrow the tail
+        summary = json.dumps({
+            "metric": out.get("metric"), "value": out.get("value"),
+            "unit": out.get("unit"),
+            "vs_baseline": out.get("vs_baseline"),
+            "mfu": s.get("mfu"),
+            "summary_overflow": len(summary)})
+    print(summary, flush=True)

@@ -273,6 +273,190 @@ def _time_calls(fn, fetch, n: int) -> float:
     return best / n
 
 
+def _cb_ab_bench(qparams, cfg, slots: int, prompt: int, new: int,
+                 stride: int, reqs: int, page: int, kv_int8: bool,
+                 iters: int) -> dict:
+    """Three-way continuous-batching A/B at one shape: the static
+    formulation, the dense-cache slot engine, and the PAGED engine
+    (``kv_int8`` pages when the shape sits past llama_serve's
+    n_slots x prompt >= 16k crossover).  The e2e figure of record is
+    DEVICE-ANCHORED: deterministic dispatch counts x per-dispatch costs
+    chained-measured in the same window — the r3 raw-wall number swung
+    10x with tunnel weather because ~480 ms of device work hid under
+    seconds of fluctuating dispatch overhead.  Raw wall time is still
+    reported, labeled as weather."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.models import greedy_generate
+    from kubegpu_tpu.models.serve import ContinuousBatcher
+
+    cb_len = prompt + new + stride + 8
+    cb_p = np.arange(prompt) % cfg.vocab_size
+    # static comparator at the same shape/params/cache dtype
+    cb_sp = jnp.asarray(
+        np.arange(slots * prompt).reshape(slots, prompt)
+        % cfg.vocab_size, jnp.int32)
+    static_s = _time_calls(
+        lambda: greedy_generate(qparams, cb_sp, new, cfg,
+                                max_len=cb_len),
+        lambda o: o, iters)
+    static_tps = slots * new / static_s
+
+    def run_engine(paged: bool) -> dict:
+        quant = paged and kv_int8
+        eng = ContinuousBatcher(
+            qparams, cfg, n_slots=slots, max_len=cb_len,
+            stride=stride, prompt_buckets=(prompt,),
+            paged=paged, page_size=page, kv_int8=quant)
+        eng.warmup()   # state-free: compiles every wave size + block
+        t0 = time.perf_counter()
+        for i in range(reqs):
+            eng.submit((cb_p + i) % cfg.vocab_size, new)
+        done = eng.drain()
+        elapsed = time.perf_counter() - t0
+        ticks = eng.slot_steps // (stride * slots)
+        total = sum(len(r.tokens) for r in done)
+        # per-dispatch costs, chained in THIS window, on the engine's
+        # own executables and a throwaway engine state
+        probe = ContinuousBatcher(
+            qparams, cfg, n_slots=slots, max_len=cb_len,
+            stride=stride, prompt_buckets=(prompt,),
+            paged=paged, page_size=page, kv_int8=quant)
+        # fill EVERY probe slot before chaining: the paged kernel's
+        # work scales with the pages active rows actually hold, so a
+        # 1-of-8-slots probe would undercount the block cost ~8x and
+        # flatter the anchored e2e (r4 review catch)
+        for i in range(slots):
+            probe.submit((cb_p + i) % cfg.vocab_size, new)
+        probe.step()
+        assert probe.active.all(), "probe must run at full occupancy"
+        occ_scalars = dict(occupancy=round(eng.occupancy, 3),
+                           waves=eng.prefill_waves,
+                           wave_sizes=list(eng.wave_sizes))
+        del eng  # its pool/cache is dead weight during the probe
+        # chained block rate: drive the probe's step() dispatch path
+        # directly via its jitted decode_block on its live state
+        if paged:
+            st0 = (probe.pool, probe.tokens)
+            act = jnp.asarray(probe.active)
+
+            def chain(st):
+                # device-resident tables (probe.step() uploaded them):
+                # re-uploading per call would re-add the very dispatch
+                # overhead the engine's dirty-tracking removed
+                pool, tok = st
+                _, tok, _, pool = probe._fns[0](
+                    qparams, pool, probe._pt_dev, probe._tvec_dev,
+                    probe._tpad_dev, tok, probe.pos, act,
+                    probe.temps, probe._base_key, jnp.int32(0))
+                return pool, tok
+        else:
+            st0 = (probe.cache, probe.tokens)
+            act = jnp.asarray(probe.active)
+
+            def chain(st):
+                cache, tok = st
+                _, tok, _, cache = probe._fns[0](
+                    qparams, cache, tok, probe.pos, act, probe.temps,
+                    probe._base_key, jnp.int32(0))
+                return cache, tok
+        blk_s, _ = _time_chained(chain, st0, iters=max(iters * 8, 8))
+        # per-wave admission cost (prefill + adopt), same protocol;
+        # the adopt (which donates its pool/cache) chains through the
+        # pool state so repeated calls stay valid
+        pf = probe._fns[1]
+        # admission cost measured at each WAVE SIZE the drain actually
+        # dispatched (max_wave defaults to 8, so waves are usually
+        # [k=8, k=8, ...]) — probing only k=1 would undercount the
+        # admission term ~7x.  Small ops need amplified bursts: at
+        # ~2-4 ms per call a 3-call burst sits under the tunnel's RTT
+        # jitter floor.
+        vec_i = jnp.zeros((slots,), jnp.int32)
+        vec_f = jnp.zeros((slots,), jnp.float32)
+        big0 = jax.tree.map(jnp.zeros_like,
+                            probe.pool if paged else probe.cache)
+        wave_cost_s: dict[int, float] = {}
+        for kwave in sorted(set(occ_scalars["wave_sizes"])):
+            padded = jnp.zeros((kwave, prompt), jnp.int32)
+            lens = jnp.ones((kwave,), jnp.int32)
+            pf_s = _time_calls(
+                lambda: pf(qparams, padded, lens, vec_f[:kwave],
+                           probe._base_key, jnp.int32(0))[0],
+                lambda o: o, max((iters * 10) // kwave, 8))
+            firsts1, cache_w1 = pf(qparams, padded, lens,
+                                   vec_f[:kwave], probe._base_key,
+                                   jnp.int32(0))
+            slotsk = jnp.arange(kwave, dtype=jnp.int32)
+            if paged:
+                pdst = jnp.zeros((kwave, prompt // page), jnp.int32)
+
+                def adopt_chain(st):
+                    new_ = probe._fns[2](
+                        {"k": st[0], "v": st[1],
+                         **({"k_scale": st[2], "v_scale": st[3]}
+                            if quant else {})}, cache_w1, pdst,
+                        slotsk, firsts1, lens, vec_f[:kwave], vec_i,
+                        vec_i, vec_i, vec_f, kwave)[0]
+                    return ((new_["k"], new_["v"], new_["k_scale"],
+                             new_["v_scale"]) if quant
+                            else (new_["k"], new_["v"]))
+            else:
+                def adopt_chain(st):
+                    new_ = probe._fns[2](
+                        {"k": st[0], "v": st[1]}, cache_w1, slotsk,
+                        firsts1, lens, vec_f[:kwave], vec_i, vec_i,
+                        vec_i, vec_f, kwave)[0]
+                    return (new_["k"], new_["v"])
+            st_big = ((big0["k"], big0["v"], big0["k_scale"],
+                       big0["v_scale"]) if quant and paged
+                      else (big0["k"], big0["v"]))
+            adopt_s, st_big = _time_chained(
+                adopt_chain, st_big, iters=max(iters * 20, 20))
+            if quant and paged:
+                big0 = {"k": st_big[0], "v": st_big[1],
+                        "k_scale": st_big[2], "v_scale": st_big[3]}
+            else:
+                big0 = {"k": st_big[0], "v": st_big[1]}
+            wave_cost_s[kwave] = pf_s + adopt_s
+        anchored_s = ticks * blk_s + sum(
+            wave_cost_s[k_] for k_ in occ_scalars["wave_sizes"])
+        return {
+            "occupancy": occ_scalars["occupancy"],
+            "ticks": ticks, "waves": occ_scalars["waves"],
+            "tokens": total,
+            "e2e_ms_raw_weather": round(elapsed * 1e3, 1),
+            "block_ms": round(blk_s * 1e3, 3),
+            "decode_tokens_per_s": round(slots * stride / blk_s, 1),
+            "e2e_tokens_per_s_anchored": round(total / anchored_s, 1),
+            "vs_static_e2e_anchored": round(
+                (total / anchored_s) / static_tps, 3),
+        }
+
+    dense = run_engine(paged=False)
+    paged = run_engine(paged=True)
+    return {
+        "n_slots": slots, "prompt_len": prompt,
+        "new_tokens": new, "stride": stride,
+        "requests": reqs,
+        "pooled_tokens": slots * prompt,
+        "kv_int8_pages": kv_int8,
+        "static_e2e_tokens_per_s": round(static_tps, 1),
+        "dense": dense,
+        "paged": paged,
+        "paged_vs_dense": round(
+            paged["e2e_tokens_per_s_anchored"]
+            / dense["e2e_tokens_per_s_anchored"], 3)
+        if dense["e2e_tokens_per_s_anchored"] else 0.0,
+        # headline figures = the paged engine (the serving default)
+        "occupancy": paged["occupancy"],
+        "decode_tokens_per_s": paged["decode_tokens_per_s"],
+        "e2e_tokens_per_s_anchored": paged["e2e_tokens_per_s_anchored"],
+        "vs_static_e2e": paged["vs_static_e2e_anchored"],
+    }
+
+
 def _families_bench(cfg, params, on_tpu) -> dict:
     """Reproducible rows for every non-flagship BASELINE.md hardware
     figure (VERDICT r2 weak #2: those numbers were session anecdotes no
@@ -415,167 +599,28 @@ def _families_bench(cfg, params, on_tpu) -> dict:
     # Same-window three-way A/B (VERDICT r3 next-item #2): the static
     # formulation, the dense-cache slot engine, and the PAGED engine
     # (pallas paged-attention pool) are measured inside this one bench
-    # invocation with one protocol.  The e2e figure of record is
-    # DEVICE-ANCHORED: deterministic dispatch counts x per-dispatch
-    # costs chained-measured in the same window — the r3 raw-wall
-    # number swung 10x with tunnel weather (BENCH_r03 recorded 0.069x
-    # while BASELINE cited a 0.744x session) because ~480 ms of device
-    # work hid under seconds of fluctuating dispatch overhead.  Raw
-    # wall time is still reported, labeled as weather.
-    from kubegpu_tpu.models.serve import ContinuousBatcher
+    # invocation with one protocol — TWICE: at the historical 8 x 512
+    # shape (where dense wins — the small-scale fast path) and at the
+    # FLAGSHIP serving scale 32 slots x 1024 prompt (32k pooled tokens,
+    # >= the 16k crossover where llama_serve auto-enables int8 pages),
+    # where the paged pool's wins live.  VERDICT r4 weak #4: the paged
+    # win existed only in builder-written BASELINE.md because the bench
+    # only measured the shape where paged loses.
     if on_tpu:
-        cb_slots, cb_prompt, cb_new, cb_stride, cb_reqs = 8, 512, 64, 16, 24
-        cb_page = 128
+        out["continuous_batching"] = _cb_ab_bench(
+            qparams, cfg, slots=8, prompt=512, new=64, stride=16,
+            reqs=24, page=128, kv_int8=False, iters=iters)
+        out["continuous_batching_flagship"] = _cb_ab_bench(
+            qparams, cfg, slots=32, prompt=1024, new=64, stride=16,
+            reqs=48, page=128, kv_int8=True, iters=iters)
     else:
-        cb_slots, cb_prompt, cb_new, cb_stride, cb_reqs = 2, 8, 4, 2, 4
-        cb_page = 8
-    cb_len = cb_prompt + cb_new + cb_stride + 8
-    cb_p = np.arange(cb_prompt) % cfg.vocab_size
-    # static comparator at the same shape/params/cache dtype
-    cb_sp = prompt_of(cb_slots, cb_prompt, cfg.vocab_size)
-    static_s = _time_calls(
-        lambda: greedy_generate(qparams, cb_sp, cb_new, cfg,
-                                max_len=cb_len),
-        lambda o: o, iters)
-    static_tps = cb_slots * cb_new / static_s
-
-    def run_engine(paged: bool) -> dict:
-        eng = ContinuousBatcher(
-            qparams, cfg, n_slots=cb_slots, max_len=cb_len,
-            stride=cb_stride, prompt_buckets=(cb_prompt,),
-            paged=paged, page_size=cb_page)
-        eng.warmup()   # state-free: compiles every wave size + block
-        t0 = time.perf_counter()
-        for i in range(cb_reqs):
-            eng.submit((cb_p + i) % cfg.vocab_size, cb_new)
-        done = eng.drain()
-        elapsed = time.perf_counter() - t0
-        ticks = eng.slot_steps // (cb_stride * cb_slots)
-        total = sum(len(r.tokens) for r in done)
-        # per-dispatch costs, chained in THIS window, on the engine's
-        # own executables and a throwaway engine state
-        probe = ContinuousBatcher(
-            qparams, cfg, n_slots=cb_slots, max_len=cb_len,
-            stride=cb_stride, prompt_buckets=(cb_prompt,),
-            paged=paged, page_size=cb_page)
-        # fill EVERY probe slot before chaining: the paged kernel's
-        # work scales with the pages active rows actually hold, so a
-        # 1-of-8-slots probe would undercount the block cost ~8x and
-        # flatter the anchored e2e (r4 review catch)
-        for i in range(cb_slots):
-            probe.submit((cb_p + i) % cfg.vocab_size, cb_new)
-        probe.step()
-        assert probe.active.all(), "probe must run at full occupancy"
-        occ_scalars = dict(occupancy=round(eng.occupancy, 3),
-                           waves=eng.prefill_waves,
-                           wave_sizes=list(eng.wave_sizes))
-        del eng  # its pool/cache is dead weight during the probe
-        # chained block rate: drive the probe's step() dispatch path
-        # directly via its jitted decode_block on its live state
-        if paged:
-            st0 = (probe.pool, probe.tokens)
-            act = jnp.asarray(probe.active)
-
-            def chain(st):
-                # device-resident tables (probe.step() uploaded them):
-                # re-uploading per call would re-add the very dispatch
-                # overhead the engine's dirty-tracking removed
-                pool, tok = st
-                _, tok, _, pool = probe._fns[0](
-                    qparams, pool, probe._pt_dev, probe._tvec_dev,
-                    probe._tpad_dev, tok, probe.pos, act,
-                    probe.temps, probe._base_key, jnp.int32(0))
-                return pool, tok
-        else:
-            st0 = (probe.cache, probe.tokens)
-            act = jnp.asarray(probe.active)
-
-            def chain(st):
-                cache, tok = st
-                _, tok, _, cache = probe._fns[0](
-                    qparams, cache, tok, probe.pos, act, probe.temps,
-                    probe._base_key, jnp.int32(0))
-                return cache, tok
-        blk_s, _ = _time_chained(chain, st0, iters=max(iters * 8, 8))
-        # per-wave admission cost (prefill + adopt), same protocol;
-        # the adopt (which donates its pool/cache) chains through the
-        # pool state so repeated calls stay valid
-        pf = probe._fns[1]
-        # admission cost measured at each WAVE SIZE the drain actually
-        # dispatched (max_wave defaults to 8, so waves are usually
-        # [k=8, k=8, ...]) — probing only k=1 would undercount the
-        # admission term ~7x.  Small ops need amplified bursts: at
-        # ~2-4 ms per call a 3-call burst sits under the tunnel's RTT
-        # jitter floor.
-        vec_i = jnp.zeros((cb_slots,), jnp.int32)
-        vec_f = jnp.zeros((cb_slots,), jnp.float32)
-        big0 = jax.tree.map(jnp.zeros_like,
-                            probe.pool if paged else probe.cache)
-        wave_cost_s: dict[int, float] = {}
-        for kwave in sorted(set(occ_scalars["wave_sizes"])):
-            padded = jnp.zeros((kwave, cb_prompt), jnp.int32)
-            lens = jnp.ones((kwave,), jnp.int32)
-            pf_s = _time_calls(
-                lambda: pf(qparams, padded, lens, vec_f[:kwave],
-                           probe._base_key, jnp.int32(0))[0],
-                lambda o: o, max((iters * 10) // kwave, 8))
-            firsts1, cache_w1 = pf(qparams, padded, lens,
-                                   vec_f[:kwave], probe._base_key,
-                                   jnp.int32(0))
-            slotsk = jnp.arange(kwave, dtype=jnp.int32)
-            if paged:
-                pdst = jnp.zeros((kwave, cb_prompt // cb_page),
-                                 jnp.int32)
-
-                def adopt_chain(st):
-                    new = probe._fns[2](
-                        {"k": st[0], "v": st[1]}, cache_w1, pdst,
-                        slotsk, firsts1, lens, vec_f[:kwave], vec_i,
-                        vec_i, vec_i, vec_f, kwave)[0]
-                    return (new["k"], new["v"])
-            else:
-                def adopt_chain(st):
-                    new = probe._fns[2](
-                        {"k": st[0], "v": st[1]}, cache_w1, slotsk,
-                        firsts1, lens, vec_f[:kwave], vec_i, vec_i,
-                        vec_i, vec_f, kwave)[0]
-                    return (new["k"], new["v"])
-            adopt_s, (bk_, bv_) = _time_chained(
-                adopt_chain, (big0["k"], big0["v"]),
-                iters=max(iters * 20, 20))
-            big0 = {"k": bk_, "v": bv_}   # chained state stays valid
-            wave_cost_s[kwave] = pf_s + adopt_s
-        anchored_s = ticks * blk_s + sum(
-            wave_cost_s[k_] for k_ in occ_scalars["wave_sizes"])
-        return {
-            "occupancy": occ_scalars["occupancy"],
-            "ticks": ticks, "waves": occ_scalars["waves"],
-            "tokens": total,
-            "e2e_ms_raw_weather": round(elapsed * 1e3, 1),
-            "block_ms": round(blk_s * 1e3, 3),
-            "decode_tokens_per_s": round(cb_slots * cb_stride / blk_s,
-                                         1),
-            "e2e_tokens_per_s_anchored": round(total / anchored_s, 1),
-            "vs_static_e2e_anchored": round(
-                (total / anchored_s) / static_tps, 3),
-        }
-
-    dense = run_engine(paged=False)
-    paged = run_engine(paged=True)
-    out["continuous_batching"] = {
-        "n_slots": cb_slots, "prompt_len": cb_prompt,
-        "new_tokens": cb_new, "stride": cb_stride,
-        "requests": cb_reqs,
-        "static_e2e_tokens_per_s": round(static_tps, 1),
-        "dense": dense,
-        "paged": paged,
-        # headline figures = the paged engine (the serving default)
-        "occupancy": paged["occupancy"],
-        "decode_tokens_per_s": paged["decode_tokens_per_s"],
-        "e2e_tokens_per_s_anchored": paged["e2e_tokens_per_s_anchored"],
-        "vs_static_e2e": paged["vs_static_e2e_anchored"],
-    }
-
+        out["continuous_batching"] = _cb_ab_bench(
+            qparams, cfg, slots=2, prompt=8, new=4, stride=2,
+            reqs=4, page=8, kv_int8=False, iters=iters)
+        # tiny flagship-shaped row keeps the int8-paged path covered
+        out["continuous_batching_flagship"] = _cb_ab_bench(
+            qparams, cfg, slots=2, prompt=8, new=4, stride=2,
+            reqs=4, page=8, kv_int8=True, iters=iters)
     sp = prompt_of(spec_b, spec_t, cfg.vocab_size)
     spec_len = spec_t + spec_steps
     dl = max(1, cfg.n_layers // 4)
@@ -1057,6 +1102,105 @@ def run_serve_pod_bench(timeout_s: float = 600.0) -> dict:
         "e2e_tokens_per_s": snap["gauges"].get(
             "workload_serve_e2e_tokens_per_s"),
     }
+
+
+def summarize_bench(out: dict) -> dict:
+    """Compact headline summary — the driver-captured line of record.
+
+    VERDICT r4 weak #1: BENCH_r0{3,4}.json had ``parsed: null`` and a
+    2000-char tail that truncated the one giant JSON line mid-document,
+    so the round's flagship numbers (MFU, flash speedup, decode ladder)
+    existed in no driver artifact.  This summary is guaranteed small
+    (< ~1500 bytes) and is printed as the FINAL stdout line so it always
+    lands whole inside the driver's tail window and parses on its own.
+    Keys abbreviate but stay self-describing; the full document goes to
+    the first stdout line + BENCH_DETAILS.json."""
+    d = out.get("details", {})
+    s = {
+        "metric": out.get("metric"),
+        "value": out.get("value"),
+        "unit": out.get("unit"),
+        "vs_baseline": out.get("vs_baseline"),
+        "p99_ms": d.get("p99_ms"),
+        "locality": d.get("mean_allocation_locality"),
+    }
+
+    def err_or(node, fn):
+        if not isinstance(node, dict):
+            return None
+        if "error" in node:
+            return {"error": str(node["error"])[:120]}
+        return fn(node)
+
+    m = d.get("model")
+    if isinstance(m, dict) and "error" not in m:
+        s["mfu"] = m.get("mfu")
+        s["train_step_ms"] = m.get("step_ms")
+        s["train_tok_s"] = m.get("tokens_per_s")
+        att = m.get("attention") or {}
+        s["flash_speedup"] = att.get("pallas_speedup")
+        sv = m.get("serving") or {}
+        s["decode_tok_s"] = {
+            "bf16": sv.get("decode_tokens_per_s"),
+            "int8": sv.get("int8_decode_tokens_per_s"),
+            "int8_kv": sv.get("int8_kv_decode_tokens_per_s"),
+            "int8_kv_b4x": sv.get("int8_kv_decode_b4x_tokens_per_s"),
+        }
+        fam = m.get("families") or {}
+        cb = fam.get("continuous_batching") or {}
+        s["cb"] = {
+            "static": cb.get("static_e2e_tokens_per_s"),
+            "dense_x": (cb.get("dense") or {}).get(
+                "vs_static_e2e_anchored"),
+            "paged_x": (cb.get("paged") or {}).get(
+                "vs_static_e2e_anchored"),
+            "paged_tok_s": cb.get("decode_tokens_per_s"),
+        }
+        cbf = fam.get("continuous_batching_flagship") or {}
+        if cbf:
+            s["cb_flagship"] = {
+                "static": cbf.get("static_e2e_tokens_per_s"),
+                "dense_x": (cbf.get("dense") or {}).get(
+                    "vs_static_e2e_anchored"),
+                "paged_x": (cbf.get("paged") or {}).get(
+                    "vs_static_e2e_anchored"),
+                "paged_tok_s": cbf.get("decode_tokens_per_s"),
+            }
+        pld = fam.get("spec_decode_pld") or {}
+        s["pld"] = {"x": pld.get("speedup_vs_greedy"),
+                    "acc": pld.get("acceptance_rate")}
+        curve = fam.get("spec_decode_pld_curve")
+        if curve:
+            s["pld_curve"] = [
+                [p.get("acceptance_rate"), p.get("speedup_vs_greedy")]
+                for p in curve]
+        spec = fam.get("spec_decode") or {}
+        s["spec_self_x"] = spec.get("speedup_vs_greedy")
+    elif isinstance(m, dict):
+        s["model"] = {"error": str(m["error"])[:120]}
+
+    sc = err_or(d.get("scheduler_scale_1024chip"), lambda n: {
+        "cold_p50": n.get("cold", {}).get("p50_ms"),
+        "steady_p50": n.get("steady_state", {}).get("p50_ms"),
+        "loc": n.get("steady_state", {}).get("mean_allocation_locality"),
+    })
+    if sc:
+        s["sched_1024"] = sc
+    ms = err_or(d.get("scheduler_scale_multislice"), lambda n: {
+        "p99": n.get("p99_ms"), "frac": n.get("multislice_fraction"),
+        "loc": n.get("mean_allocation_locality"),
+    })
+    if ms:
+        s["multislice"] = ms
+    w = err_or(d.get("scheduler_wire"),
+               lambda n: {"p50": n.get("p50_ms"), "max": n.get("max_ms")})
+    if w:
+        s["wire_ms"] = w
+    sp = err_or(d.get("serve_pod"),
+                lambda n: {"decode_tok_s": n.get("decode_tokens_per_s")})
+    if sp:
+        s["serve_pod"] = sp
+    return s
 
 
 def run_full_bench(n_gangs: int = 60, seed: int = 0) -> dict:

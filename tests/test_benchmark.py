@@ -34,17 +34,23 @@ class TestModelBench:
         fam = out["families"]
         assert set(fam) == {"moe_serving", "t5_serving", "lora",
                             "beam", "spec_decode", "spec_decode_pld",
-                            "continuous_batching"}
-        cb = fam["continuous_batching"]
-        assert cb["e2e_tokens_per_s_anchored"] > 0
-        assert cb["decode_tokens_per_s"] > 0
-        assert 0 < cb["occupancy"] <= 1
-        # the same-window A/B must carry both engine modes, each with
-        # the device-anchored e2e figure
-        for mode in ("dense", "paged"):
-            assert cb[mode]["e2e_tokens_per_s_anchored"] > 0
-            assert cb[mode]["decode_tokens_per_s"] > 0
-            assert cb[mode]["ticks"] > 0 and cb[mode]["waves"] > 0
+                            "continuous_batching",
+                            "continuous_batching_flagship"}
+        for row in ("continuous_batching", "continuous_batching_flagship"):
+            cb = fam[row]
+            assert cb["e2e_tokens_per_s_anchored"] > 0
+            assert cb["decode_tokens_per_s"] > 0
+            assert 0 < cb["occupancy"] <= 1
+            assert cb["paged_vs_dense"] > 0
+            # the same-window A/B must carry both engine modes, each
+            # with the device-anchored e2e figure
+            for mode in ("dense", "paged"):
+                assert cb[mode]["e2e_tokens_per_s_anchored"] > 0
+                assert cb[mode]["decode_tokens_per_s"] > 0
+                assert cb[mode]["ticks"] > 0 and cb[mode]["waves"] > 0
+        # the flagship row exercises int8 KV pages (the >=16k-pooled-
+        # tokens crossover configuration)
+        assert fam["continuous_batching_flagship"]["kv_int8_pages"]
         assert fam["moe_serving"]["gen_tokens_per_s_e2e"] > 0
         assert fam["t5_serving"]["gen_tokens_per_s_e2e"] > 0
         assert fam["lora"]["step_ms"] > 0
@@ -105,3 +111,106 @@ def test_multislice_bench_crosses_dcn():
     assert 0 < d["multislice_fraction"] <= 1
     assert d["mean_allocation_locality"] > 0.8
     assert out["value"] >= 0
+
+
+class TestSummary:
+    """The driver-captured final line (VERDICT r4 next-item #1): small
+    enough to survive a ~2000-char tail window whole, and carrying the
+    headline metrics — above all "mfu"."""
+
+    def _full_doc(self):
+        # synthetic full document with every section present at
+        # hardware-like values, so the size bound is tested against the
+        # worst realistic payload, not a CPU-tiny one
+        return {
+            "metric": "gang_schedule_p50_latency", "value": 0.86,
+            "unit": "ms", "vs_baseline": 58.14,
+            "details": {
+                "p90_ms": 2.1, "p99_ms": 9.4, "decisions": 88,
+                "mean_allocation_locality": 0.9662,
+                "model": {
+                    "mfu": 0.6612, "step_ms": 219.4,
+                    "tokens_per_s": 37332.1,
+                    "attention": {"pallas_speedup": 3.31},
+                    "serving": {
+                        "decode_tokens_per_s": 4402.1,
+                        "int8_decode_tokens_per_s": 6689.9,
+                        "int8_kv_decode_tokens_per_s": 7001.2,
+                        "int8_kv_decode_b4x_tokens_per_s": 12961.4,
+                    },
+                    "families": {
+                        "continuous_batching": {
+                            "static_e2e_tokens_per_s": 5282.0,
+                            "dense": {"vs_static_e2e_anchored": 1.123},
+                            "paged": {"vs_static_e2e_anchored": 1.081},
+                            "decode_tokens_per_s": 8649.0,
+                        },
+                        "continuous_batching_flagship": {
+                            "static_e2e_tokens_per_s": 13600.0,
+                            "dense": {"vs_static_e2e_anchored": 1.01},
+                            "paged": {"vs_static_e2e_anchored": 1.11},
+                            "decode_tokens_per_s": 15100.0,
+                        },
+                        "spec_decode": {"speedup_vs_greedy": 0.48},
+                        "spec_decode_pld": {
+                            "speedup_vs_greedy": 2.49,
+                            "acceptance_rate": 1.0},
+                        "spec_decode_pld_curve": [
+                            {"acceptance_rate": 0.31,
+                             "speedup_vs_greedy": 0.9},
+                            {"acceptance_rate": 0.52,
+                             "speedup_vs_greedy": 1.4},
+                            {"acceptance_rate": 0.71,
+                             "speedup_vs_greedy": 1.9},
+                        ],
+                    },
+                },
+                "scheduler_scale_1024chip": {
+                    "cold": {"p50_ms": 0.86,
+                             "mean_allocation_locality": 0.966},
+                    "steady_state": {"p50_ms": 0.90,
+                                     "mean_allocation_locality": 0.966},
+                },
+                "scheduler_scale_multislice": {
+                    "p99_ms": 10.2, "multislice_fraction": 0.16,
+                    "mean_allocation_locality": 0.952},
+                "scheduler_wire": {"p50_ms": 1.4, "max_ms": 5.5},
+                "serve_pod": {"decode_tokens_per_s": 12961.0},
+            },
+        }
+
+    def test_summary_small_and_carries_headlines(self):
+        import json
+
+        from kubegpu_tpu.benchmark import summarize_bench
+        s = summarize_bench(self._full_doc())
+        line = json.dumps(s)
+        assert len(line) < 1500, f"summary too big: {len(line)}"
+        assert s["metric"] == "gang_schedule_p50_latency"
+        assert s["vs_baseline"] == 58.14
+        assert s["mfu"] == 0.6612
+        assert s["flash_speedup"] == 3.31
+        assert s["decode_tok_s"]["int8_kv_b4x"] == 12961.4
+        assert s["cb"]["paged_x"] == 1.081
+        assert s["cb_flagship"]["paged_x"] == 1.11
+        assert s["pld"]["x"] == 2.49
+        assert len(s["pld_curve"]) == 3
+        assert s["sched_1024"]["cold_p50"] == 0.86
+        assert s["multislice"]["frac"] == 0.16
+        assert "mfu" in line  # the driver's done-bar grep
+
+    def test_summary_survives_errors_and_absence(self):
+        import json
+
+        from kubegpu_tpu.benchmark import summarize_bench
+        doc = {"metric": "m", "value": 1.0, "unit": "ms",
+               "vs_baseline": 2.0,
+               "details": {"model": {"error": "chip fell over " * 30},
+                           "scheduler_wire": {"error": "x"}}}
+        s = summarize_bench(doc)
+        line = json.dumps(s)
+        assert len(line) < 1500
+        assert s["model"]["error"].startswith("chip fell over")
+        assert len(s["model"]["error"]) <= 120
+        s2 = summarize_bench({"metric": "m", "value": 1.0})
+        assert s2["metric"] == "m"
