@@ -48,14 +48,19 @@ def build_agent(args):
     else:
         runtime = FakeRuntime()
     node_name = backend.discover().node_name
-    if getattr(args, "transport", "json") == "grpc":
+    transport = getattr(args, "transport", "json")
+    if transport.startswith("grpc"):
         from kubegpu_tpu.crishim.grpcserver import (
             GrpcCriServer,
             GrpcRemoteCriShim,
         )
+        # "grpc" = runtime.v1 protobuf bodies (kubelet-compatible);
+        # "grpc-json" keeps the r3 JSON-body behavior
+        codec = "json" if transport == "grpc-json" else "proto"
         server = GrpcCriServer(api, backend, node_name, runtime,
-                               socket_path=args.cri_socket).start()
-        shim = GrpcRemoteCriShim(server.socket_path)
+                               socket_path=args.cri_socket,
+                               codec=codec).start()
+        shim = GrpcRemoteCriShim(server.socket_path, codec=codec)
     else:
         server = CriServer(api, backend, node_name, runtime,
                            socket_path=args.cri_socket).start()
@@ -79,9 +84,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--host-id", type=int, default=0,
                     help="mock backend host index within the slice")
     ap.add_argument("--transport", default="json",
-                    choices=("json", "grpc"),
+                    choices=("json", "grpc", "grpc-json"),
                     help="CRI wire transport: length-prefixed JSON "
-                         "frames or real gRPC (runtime.v1 services)")
+                         "frames, real gRPC with runtime.v1 protobuf "
+                         "bodies, or gRPC with JSON bodies (fallback)")
     ap.add_argument("--cri-socket", default=None,
                     help="unix socket path for the CRI server "
                     "(default: a fresh temp path, printed at startup)")

@@ -1,0 +1,160 @@
+"""Golden-bytes tests for the hand-rolled runtime.v1 proto codec.
+
+VERDICT r4 next-item #5's done bar: the encodings are checked against
+HAND-COMPUTED byte strings (not just round-trips), so the codec can't
+be self-consistently wrong about the wire format a stock kubelet
+speaks.  Wire rules under test: varint field keys (num << 3 | wt),
+LEB128 varints, two's-complement negative ints, length-delimited
+strings/messages, map entries as {key=1, value=2} submessages,
+repeated fields as repeated tags, proto3 default elision, and
+unknown-field skipping."""
+
+import pytest
+
+from kubegpu_tpu.crishim.protowire import (
+    MESSAGES,
+    decode_message,
+    decode_varint,
+    encode_message,
+    encode_varint,
+)
+
+
+class TestVarint:
+    @pytest.mark.parametrize("n,raw", [
+        (0, b"\x00"),
+        (1, b"\x01"),
+        (127, b"\x7f"),
+        (128, b"\x80\x01"),
+        (300, b"\xac\x02"),               # the protobuf docs' example
+        (1 << 32, b"\x80\x80\x80\x80\x10"),
+    ])
+    def test_known_encodings(self, n, raw):
+        assert encode_varint(n) == raw
+        assert decode_varint(raw, 0) == (n, len(raw))
+
+    def test_negative_int_is_twos_complement_10_bytes(self):
+        # -1 as int64: 0xFFFFFFFFFFFFFFFF -> ten 0xff..0x01 bytes
+        raw = encode_varint(-1)
+        assert raw == b"\xff" * 9 + b"\x01"
+        v, _ = decode_varint(raw, 0)
+        assert v == (1 << 64) - 1
+
+    def test_truncated_varint_raises(self):
+        with pytest.raises(ValueError, match="truncated"):
+            decode_varint(b"\x80", 0)
+
+
+class TestGoldenMessages:
+    def test_version_request(self):
+        schema = MESSAGES["Version"][0]
+        # field 1 (string "v1"): key = 1<<3|2 = 0x0a, len 2
+        assert encode_message(schema, {"version": "v1"}) == \
+            b"\x0a\x02v1"
+        assert encode_message(schema, {}) == b""   # defaults elided
+
+    def test_pull_image_request(self):
+        schema = MESSAGES["PullImage"][0]
+        # image (field 1, msg) { image (field 1, string) = "a:b" }
+        inner = b"\x0a\x03a:b"
+        want = b"\x0a" + bytes([len(inner)]) + inner
+        assert encode_message(schema, {"image": {"image": "a:b"}}) == want
+        got = decode_message(schema, want)
+        assert got["image"]["image"] == "a:b"
+
+    def test_container_status_response_with_negative_exit(self):
+        schema = MESSAGES["ContainerStatus"][1]
+        obj = {"status": {"id": "c1", "state": "CONTAINER_EXITED",
+                          "exit_code": -9}}
+        raw = encode_message(schema, obj)
+        # status = field 1 msg: id(1,str)="c1" -> 0a 02 63 31;
+        # state(3,enum)=2 -> 18 02; exit_code(7,int)=-9 ->
+        # 38 + ten-byte twos complement of -9
+        inner = (b"\x0a\x02c1" + b"\x18\x02"
+                 + b"\x38" + b"\xf7" + b"\xff" * 8 + b"\x01")
+        assert raw == b"\x0a" + bytes([len(inner)]) + inner
+        back = decode_message(schema, raw)
+        assert back["status"]["state"] == "CONTAINER_EXITED"
+        assert back["status"]["exit_code"] == -9
+
+    def test_map_entry_layout(self):
+        schema = MESSAGES["CreateContainer"][0]
+        obj = {"config": {"labels": {"k": "v"}}}
+        raw = encode_message(schema, obj)
+        # config = field 2 msg -> key 0x12; labels = field 9 map ->
+        # key 9<<3|2 = 0x4a; entry = key(1,str)"k" + value(2,str)"v"
+        entry = b"\x0a\x01k\x12\x01v"
+        labels = b"\x4a" + bytes([len(entry)]) + entry
+        assert raw == b"\x12" + bytes([len(labels)]) + labels
+        assert decode_message(schema, raw)["config"]["labels"] == \
+            {"k": "v"}
+
+    def test_repeated_strings(self):
+        schema = MESSAGES["ImageStatus"][1]
+        obj = {"image": {"id": "i", "repo_tags": ["a", "b"], "size": 5}}
+        raw = encode_message(schema, obj)
+        inner = (b"\x0a\x01i"            # id = field 1
+                 + b"\x12\x01a\x12\x01b"  # repo_tags = field 2, twice
+                 + b"\x20\x05")           # size = field 4 varint
+        assert raw == b"\x0a" + bytes([len(inner)]) + inner
+        back = decode_message(schema, raw)
+        assert back["image"]["repo_tags"] == ["a", "b"]
+        assert back["image"]["size"] == 5
+
+    def test_filesystem_usage_nested(self):
+        schema = MESSAGES["ImageFsInfo"][1]
+        obj = {"image_filesystems": [{
+            "timestamp": 7,
+            "fs_id": {"mountpoint": "/tmp"},
+            "used_bytes": {"value": 300},
+            "inodes_used": {"value": 2}}]}
+        raw = encode_message(schema, obj)
+        fs = (b"\x08\x07"                        # timestamp = 1
+              + b"\x12\x06\x0a\x04/tmp"          # fs_id.mountpoint
+              + b"\x1a\x03\x08\xac\x02"          # used_bytes.value=300
+              + b"\x22\x02\x08\x02")             # inodes_used.value=2
+        assert raw == b"\x0a" + bytes([len(fs)]) + fs
+        back = decode_message(schema, raw)
+        assert back["image_filesystems"][0]["used_bytes"]["value"] == 300
+
+
+class TestRobustness:
+    def test_unknown_fields_skipped(self):
+        schema = MESSAGES["Version"][1]
+        known = encode_message(schema, {"runtime_name": "rt"})
+        # splice in unknown field 99 (varint) and field 98 (len-delim)
+        unknown = (encode_varint((99 << 3) | 0) + encode_varint(5)
+                   + encode_varint((98 << 3) | 2) + b"\x03abc")
+        back = decode_message(schema, unknown + known)
+        assert back["runtime_name"] == "rt"
+
+    def test_defaults_materialized(self):
+        schema = MESSAGES["ImageStatus"][1]
+        back = decode_message(schema, b"")
+        assert back["image"] is None        # absent singular message
+        assert back["info"] == {}           # absent map
+
+    def test_info_map_json_values_roundtrip(self):
+        schema = MESSAGES["CreateContainer"][1]
+        obj = {"container_id": "c",
+               "info": {"env": {"TPU_VISIBLE_CHIPS": "0,1"},
+                        "pid": 42, "note": "plain"}}
+        back = decode_message(schema, encode_message(schema, obj))
+        assert back["info"]["env"] == {"TPU_VISIBLE_CHIPS": "0,1"}
+        assert back["info"]["pid"] == 42
+        assert back["info"]["note"] == "plain"
+
+    def test_every_method_empty_roundtrip(self):
+        """Each of the 12 verb pairs encodes/decodes an empty message
+        (defaults materialize per schema, nothing raises)."""
+        assert len(MESSAGES) == 12
+        for method, (req, resp) in MESSAGES.items():
+            for schema in (req, resp):
+                assert decode_message(
+                    schema, encode_message(schema, {})) is not None
+
+    def test_truncated_field_raises(self):
+        schema = MESSAGES["PullImage"][0]
+        raw = encode_message(schema, {"image": {"image": "abc"}})
+        with pytest.raises(ValueError):
+            decode_message(schema, raw[:-1])
