@@ -64,6 +64,10 @@ class CriShim:
             env["KUBETPU_HBM_GIB"] = str(round(sum(
                 by_local[c.local_index].hbm_gib * c.millichips / 1000
                 for c in alloc.chips), 3))
+            # slice identity: a multislice gang's workers learn which
+            # ICI domain they sit in (dp spans slices over DCN; the
+            # slice id is the boundary a MEGASCALE-style runtime needs)
+            env["KUBETPU_SLICE_ID"] = alloc.slice_id
             axes = pod_mesh_axes(pod)
             if axes:
                 # close the loop: the mesh the allocator optimized

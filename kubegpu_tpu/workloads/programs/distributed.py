@@ -21,6 +21,7 @@ class WorkerEnv:
     hostnames: list[str]
     millitpu: int | None
     hbm_gib: float | None = None   # allocated HBM (crishim-injected)
+    slice_id: str = ""             # ICI domain this worker sits in
 
 
 def read_env() -> WorkerEnv:
@@ -36,6 +37,7 @@ def read_env() -> WorkerEnv:
             "TPU_WORKER_HOSTNAMES", "").split(",") if h],
         millitpu=int(milli) if milli else None,
         hbm_gib=float(hbm) if hbm else None,
+        slice_id=os.environ.get("KUBETPU_SLICE_ID", ""),
     )
 
 
