@@ -32,6 +32,9 @@ fa = importlib.import_module("kubegpu_tpu.ops.flash_attention")
 B, HQ, HKV, T, D = 4, 16, 4, 2048, 128
 DT = jnp.bfloat16
 RAW_BWD = fa.flash_attention_bwd.__wrapped__
+# shipped defaults to restore between variants (cap is 512 since r5)
+_ORIG_CAP = fa.DKV_GROUPED_BQ_CAP
+_ORIG_BUDGET = fa.DKV_PANEL_BUDGET
 
 
 def timeit(fn, state, iters=50):
@@ -69,17 +72,26 @@ def main():
     fwdl_s = timeit(fwd_lse, q)
     print(f"fwd (+lse):        {fwdl_s*1e3:8.3f} ms", flush=True)
 
+    variants = {
+        "dq": ("dq only           bq512/bk512", 256, 6 << 20, 512, 512,
+               "dq"),
+        "dkv_cur": ("dkv only grouped  bq256/bk512", 256, 6 << 20, 512,
+                    512, "dkv"),
+        "full_cur": ("full grouped      bq256/bk512 (current)",
+                     256, 6 << 20, 512, 512, "all"),
+        "full_512": ("full grouped      bq512/bk512 (vmem?)",
+                     512, 6 << 20, 512, 512, "all"),
+        "dkv_bk256": ("dkv only grouped  bq256/bk256", 256, 6 << 20,
+                      512, 256, "dkv"),
+        "dkv_degroup": ("dkv only degroup  bq512/bk512", 512, 0, 512,
+                        512, "dkv"),
+        "dkv_degroup256": ("dkv only degroup  bq256/bk512", 256, 0,
+                           512, 512, "dkv"),
+    }
+    want = sys.argv[1:] or list(variants)
     results = {}
     for label, cap, budget, bq, bk, part in (
-            ("dq only           bq512/bk512", 256, 6 << 20, 512, 512, "dq"),
-            ("dkv only grouped  bq256/bk512", 256, 6 << 20, 512, 512, "dkv"),
-            ("full grouped      bq256/bk512 (current)",
-             256, 6 << 20, 512, 512, "all"),
-            ("full grouped      bq512/bk512 (vmem?)",
-             512, 6 << 20, 512, 512, "all"),
-            ("dkv only grouped  bq256/bk256", 256, 6 << 20, 512, 256, "dkv"),
-            ("dkv only degroup  bq512/bk512", 512, 0, 512, 512, "dkv"),
-            ("dkv only degroup  bq256/bk512", 256, 0, 512, 512, "dkv")):
+            variants[w] for w in want):
         fa.DKV_GROUPED_BQ_CAP = cap
         fa.DKV_PANEL_BUDGET = budget
         try:
@@ -119,8 +131,8 @@ def main():
             print(f"bwd {label}: FAILED {type(e).__name__}: "
                   f"{str(e)[:160]}", flush=True)
         finally:
-            fa.DKV_GROUPED_BQ_CAP = 256
-            fa.DKV_PANEL_BUDGET = 6 << 20
+            fa.DKV_GROUPED_BQ_CAP = _ORIG_CAP
+            fa.DKV_PANEL_BUDGET = _ORIG_BUDGET
 
     base = results.get("full grouped      bq256/bk512 (current)")
     if base:

@@ -1,0 +1,89 @@
+"""Interleaved A/B of grouped-dkv q-block geometries (r5 item #2).
+
+Compiles every variant FIRST (the tunnel's remote-compile helper fails
+under a busy device queue), then alternates timing bursts A/B/A/B and
+reports per-variant medians — cross-window tunnel variance measured
+45% on these sub-3ms kernels, so only interleaved same-window bursts
+can rank geometries."""
+
+import importlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax                                      # noqa: E402
+import jax.numpy as jnp                         # noqa: E402
+import numpy as np                              # noqa: E402
+
+fa = importlib.import_module("kubegpu_tpu.ops.flash_attention")
+RAW_BWD = fa.flash_attention_bwd.__wrapped__
+_ORIG_CAP = fa.DKV_GROUPED_BQ_CAP
+# NB: unlike bwd_profile.py this harness deliberately skips the
+# _fetch_rtt_s compensation — the fetch overhead is CONSTANT across
+# interleaved variants, so rankings hold but absolute ms here are
+# inflated vs benchmark.py's numbers.
+
+B, HQ, HKV, T, D = 4, 16, 4, 2048, 128
+DT = jnp.bfloat16
+ITERS = 60
+ROUNDS = 5
+
+
+def fetch(x):
+    return float(np.asarray(jax.device_get(jnp.ravel(x)[0])))
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (B, HQ, T, D), DT)
+    k = jax.random.normal(kk, (B, HKV, T, D), DT)
+    v = jax.random.normal(kv, (B, HKV, T, D), DT)
+    g = jax.random.normal(kg, (B, HQ, T, D), DT)
+    out, lse = jax.jit(
+        lambda: fa.flash_attention(q, k, v, return_lse=True))()
+
+    variants = {}
+    specs = [("bq256", 256, 512), ("bq512", 512, 512),
+             ("bq128", 128, 512), ("bq256bk256", 256, 256)]
+    for name, cap, bk in specs:
+        fa.DKV_GROUPED_BQ_CAP = cap
+
+        def mk(bk=bk):
+            def run(g_):
+                dq, dk, dv = RAW_BWD(q, k, v, out, lse, g_, True,
+                                     512, bk, False)
+                del dq
+                return (g_ + (dk[0, 0, 0, 0]
+                              + dv[0, 0, 0, 0]).astype(g_.dtype)
+                        * jnp.bfloat16(1e-8))
+            return jax.jit(run)
+        try:
+            fn = mk()
+            fetch(fn(g))   # compile now, device quiet
+            variants[name] = fn
+            print(f"compiled {name}", flush=True)
+        except Exception as e:
+            print(f"{name}: COMPILE FAILED {str(e)[:120]}", flush=True)
+        finally:
+            fa.DKV_GROUPED_BQ_CAP = _ORIG_CAP
+
+    times = {n: [] for n in variants}
+    for r in range(ROUNDS):
+        for name, fn in variants.items():
+            st = g
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                st = fn(st)
+            fetch(st)
+            times[name].append((time.perf_counter() - t0) / ITERS)
+    for name, ts in times.items():
+        med = statistics.median(ts)
+        print(f"dkv {name}: median {med*1e3:7.3f} ms  "
+              f"(all: {[round(t*1e3, 3) for t in ts]})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Interleaved A/B of forward flash-attention block geometry (r5).
+
+Same protocol as dkv_ab.py: compile all variants on a quiet device,
+then alternate timing bursts so tunnel weather cancels."""
+
+import importlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax                                      # noqa: E402
+import jax.numpy as jnp                         # noqa: E402
+import numpy as np                              # noqa: E402
+
+fa = importlib.import_module("kubegpu_tpu.ops.flash_attention")
+
+B, HQ, HKV, T, D = 4, 16, 4, 2048, 128
+DT = jnp.bfloat16
+ITERS = 100
+ROUNDS = 5
+
+
+def fetch(x):
+    return float(np.asarray(jax.device_get(jnp.ravel(x)[0])))
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, HQ, T, D), DT)
+    k = jax.random.normal(kk, (B, HKV, T, D), DT)
+    v = jax.random.normal(kv, (B, HKV, T, D), DT)
+
+    variants = {}
+    for bq, bk in ((256, 512), (512, 512), (256, 1024), (512, 1024),
+                   (128, 512), (256, 2048)):
+        name = f"bq{bq}/bk{bk}"
+        try:
+            fn = jax.jit(lambda q_, bq=bq, bk=bk: fa.flash_attention(
+                q_, k, v, block_q=bq, block_k=bk))
+            fetch(fn(q))
+            variants[name] = fn
+            print(f"compiled {name}", flush=True)
+        except Exception as e:
+            print(f"{name}: COMPILE FAILED {str(e)[:120]}", flush=True)
+
+    times = {n: [] for n in variants}
+    for _ in range(ROUNDS):
+        for name, fn in variants.items():
+            st = q
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                st = fn(st)
+            fetch(st)
+            times[name].append((time.perf_counter() - t0) / ITERS)
+    for name, ts in times.items():
+        print(f"fwd {name}: median {statistics.median(ts)*1e3:7.3f} ms "
+              f"(all: {[round(t*1e3, 3) for t in ts]})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

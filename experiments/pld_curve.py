@@ -1,0 +1,88 @@
+"""Probe prompt-noise rate → PLD acceptance on the chip (r5 item #6).
+
+The bench's PLD row trains the flagship bench model to continue a
+cyclic pattern (acceptance 1.0).  To chart the acceptance curve's
+MIDDLE, the prompt's history is corrupted at rate r: lookup matches in
+noisy history propose wrong continuations while the model still emits
+the clean cycle, so acceptance falls with r.  This script measures
+acceptance + speedup at several r so the bench can bake in rates that
+land ≈ 0.3/0.5/0.7 (VERDICT r5 item #6)."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax                                        # noqa: E402
+import jax.numpy as jnp                           # noqa: E402
+import optax                                      # noqa: E402
+
+from kubegpu_tpu.benchmark import (               # noqa: E402
+    _time_calls,
+    llama_bench_config,
+)
+from kubegpu_tpu.models.decode import (           # noqa: E402
+    _pld_fused_fn,
+    greedy_generate,
+    pld_generate_fused,
+)
+from kubegpu_tpu.models.llama import llama_init, make_train_step  # noqa: E402
+from kubegpu_tpu.models.quant import quantize_llama  # noqa: E402
+
+PLD_STEPS, PAT, BATCH, SEQ = 120, 128, 4, 1024
+SPEC_T, SPEC_STEPS, GAMMA, NGRAM = 1024, 128, 8, 3
+
+
+def main():
+    cfg = llama_bench_config()
+    rng = np.random.default_rng(7)
+    pattern = rng.integers(2, cfg.vocab_size, PAT)
+    data = np.tile(pattern, SEQ * 2 // PAT + 2)
+    params = llama_init(jax.random.PRNGKey(7), cfg)
+    opt = optax.adamw(3e-4)
+    state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    t0 = time.perf_counter()
+    for i in range(PLD_STEPS):
+        off = int(rng.integers(0, PAT))
+        batch = np.stack([data[off + j:off + j + SEQ]
+                          for j in range(BATCH)])
+        params, state, loss = step(params, state,
+                                   jnp.asarray(batch, jnp.int32))
+    print(f"trained {PLD_STEPS} steps in {time.perf_counter()-t0:.1f}s "
+          f"loss={float(loss):.4f}", flush=True)
+    tq = quantize_llama(params)
+
+    spec_len = SPEC_T + SPEC_STEPS
+    base = np.tile(pattern, SPEC_T // PAT + 1)[:SPEC_T]
+    run = _pld_fused_fn(cfg, SPEC_T, SPEC_STEPS, spec_len, GAMMA,
+                        NGRAM, True)
+    clean_prompt = jnp.asarray(
+        np.broadcast_to(base, (BATCH, SPEC_T)).copy(), jnp.int32)
+    tg_s = _time_calls(
+        lambda: greedy_generate(tq, clean_prompt, SPEC_STEPS, cfg,
+                                max_len=spec_len, kv_int8=True),
+        lambda o: o, 2)
+    print(f"greedy e2e: {tg_s*1e3:.1f} ms", flush=True)
+
+    for rate in (0.0, 0.05, 0.1, 0.2, 0.35, 0.5, 0.7):
+        nrng = np.random.default_rng(int(rate * 1000) + 1)
+        noisy = np.broadcast_to(base, (BATCH, SPEC_T)).copy()
+        mask = nrng.random((BATCH, SPEC_T)) < rate
+        mask[:, -NGRAM:] = False   # generation starts on-cycle
+        noisy[mask] = nrng.integers(2, cfg.vocab_size, mask.sum())
+        prompt = jnp.asarray(noisy, jnp.int32)
+        _, stats = pld_generate_fused(
+            tq, prompt, SPEC_STEPS, cfg, gamma=GAMMA, ngram=NGRAM,
+            max_len=spec_len, kv_int8=True)
+        pld_s = _time_calls(lambda: run(tq, prompt)[0], lambda o: o, 2)
+        print(f"rate {rate:4.2f}: acceptance "
+              f"{stats['acceptance_rate']:.3f} iters "
+              f"{stats['iterations']:3d} pld {pld_s*1e3:7.1f} ms "
+              f"speedup {tg_s/pld_s:5.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()

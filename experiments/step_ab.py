@@ -1,0 +1,78 @@
+"""Same-window A/B/A of the flagship train step over a kernel knob.
+
+Usage: step_ab.py [knob value knob value ...] — e.g.
+    step_ab.py DKV_GROUPED_BQ_CAP 256 DKV_GROUPED_BQ_CAP 512 \
+               DKV_GROUPED_BQ_CAP 256
+
+Each leg sets the flash_attention module constant, clears ALL jit
+caches (the custom-vjp's inner jit would otherwise replay the previous
+leg's trace — module constants are trace-time), compiles the step
+(retrying the tunnel's flaky remote-compile helper), and times 12
+chained iterations.  The bracket (A...A) bounds window drift."""
+
+import importlib
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax                                      # noqa: E402
+import jax.numpy as jnp                         # noqa: E402
+import numpy as np                              # noqa: E402
+import optax                                    # noqa: E402
+
+from kubegpu_tpu.benchmark import (             # noqa: E402
+    _time_chained,
+    chip_peak_tflops,
+    llama_bench_config,
+    train_flops_per_step,
+)
+from kubegpu_tpu.models import llama_init       # noqa: E402
+from kubegpu_tpu.models.llama import make_train_step  # noqa: E402
+
+fa = importlib.import_module("kubegpu_tpu.ops.flash_attention")
+
+
+def one_leg(cfg, batch, seq, knob, value):
+    setattr(fa, knob, value)
+    jax.clear_caches()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    tokens = jnp.asarray(
+        (np.arange(batch * seq).reshape(batch, seq)) % cfg.vocab_size,
+        jnp.int32)
+    for attempt in range(4):   # remote-compile helper is flaky
+        try:
+            step_s, state = _time_chained(
+                lambda s: step(s[0], s[1], tokens),
+                (params, opt_state), iters=12)
+            del state
+            break
+        except Exception as e:
+            if attempt == 3:
+                raise
+            print(f"  compile retry {attempt+1}: {str(e)[:90]}",
+                  flush=True)
+            time.sleep(5)
+    flops = train_flops_per_step(cfg, batch, seq)
+    peak = chip_peak_tflops(jax.devices()[0])
+    mfu = flops / step_s / (peak * 1e12)
+    print(f"{knob}={value}: step {step_s*1e3:8.2f} ms  "
+          f"MFU {mfu:.4f}", flush=True)
+    return step_s
+
+
+def main():
+    args = sys.argv[1:] or ["DKV_GROUPED_BQ_CAP", "256",
+                            "DKV_GROUPED_BQ_CAP", "512",
+                            "DKV_GROUPED_BQ_CAP", "256"]
+    legs = [(args[i], int(args[i + 1])) for i in range(0, len(args), 2)]
+    cfg = llama_bench_config()
+    for knob, value in legs:
+        one_leg(cfg, 4, 2048, knob, value)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,10 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     remat: bool = True
     attn_impl: str = "auto"   # auto | pallas | xla | ring
+    # lax.scan unroll over the stacked layers: >1 lets XLA fuse/overlap
+    # across layer boundaries at the cost of compile time (O(1) compile
+    # was the reason for the scan; unroll trades some of it back)
+    scan_unroll: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -199,7 +203,8 @@ def llama_forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         return x, None
 
     layer_fn = jax.checkpoint(layer) if cfg.remat else layer
-    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"],
+                        unroll=cfg.scan_unroll)
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return constrain(logits, mesh, ("dp", "fsdp"), "sp", "tp")

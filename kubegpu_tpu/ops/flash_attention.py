@@ -73,11 +73,17 @@ LSE_LANES = 8
 # kernel (see flash_attention_bwd): beyond this the geometry de-groups
 # via repeat_kv instead of risking a scoped-vmem compile error.
 DKV_PANEL_BUDGET = 6 * 1024 * 1024
-# Grouped-dkv q-block cap (VMEM: resident panels + 512-tall score
-# scratch overflowed the 16 MiB scoped limit at 512 — see
-# flash_attention_bwd); module-level so the bwd-profile experiment can
-# sweep it.
-DKV_GROUPED_BQ_CAP = 256
+# Grouped-dkv q-block cap.  512 needs BWD_VMEM_LIMIT's headroom (the
+# resident panels + 512-tall score scratch overflow Mosaic's default
+# 16 MiB scoped limit — the r1-r4 reason this sat at 256); the r5
+# interleaved same-window A/B measured bq512 ~9% faster than bq256
+# (3.98 vs 4.36 ms medians) with bq128/bk256 strictly worse.
+DKV_GROUPED_BQ_CAP = 512
+# Scoped-VMEM ceiling for the backward kernels: Mosaic's 16 MiB default
+# is conservative (v5e cores carry far more VMEM); the grouped dkv
+# kernel keeps whole [group·t, d] panels resident and needs the
+# headroom for the taller q-blocks the bench sweep favors.
+BWD_VMEM_LIMIT = 64 * 1024 * 1024
 
 
 _warned_fallback: set = set()
@@ -170,15 +176,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None):
         qi = pl.program_id(2)
-        qb = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+        # Dots run in the INPUT dtype with f32 accumulation
+        # (preferred_element_type): bf16 inputs feed the MXU natively —
+        # the former .astype(f32) upcasts forced multi-pass f32 matmuls
+        # (r5 on-chip attribution: the bwd dkv kernel sat at 2.8x fwd
+        # where ~1.5x is FLOPs-ideal) and doubled the VMEM block
+        # footprint.  Scale applies to the f32 scores, not to q.
+        qb = q_ref[0]                              # [bq, d]
 
         def body(ki, carry):
             o_acc, m_acc, l_acc = carry
-            kb = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            kb = k_ref[0, pl.ds(ki * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(ki * block_k, block_k), :]
             sc = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [bq, bk]
+                preferred_element_type=jnp.float32) * scale  # [bq, bk]
             if causal:
                 qpos = causal_offset + qi * block_q + \
                     jax.lax.broadcasted_iota(
@@ -191,7 +203,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             alpha = jnp.exp(m_acc - m_new)
             l_new = alpha * l_acc + p.sum(axis=-1, keepdims=True)
             o_new = alpha * o_acc + jax.lax.dot_general(
-                p, vb, (((1,), (0,)), ((), ())),
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             return o_new, m_new, l_new
 
@@ -312,11 +324,11 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
     degroup_kv = group > 1 and panel_bytes > DKV_PANEL_BUDGET
     group_kv = 1 if degroup_kv else group
     # The grouped dkv kernel keeps the whole [group·t, d] q/do panels
-    # resident in VMEM; at group 4 / t 2048 / d 128 that plus 512-tall
-    # score scratch overflows the 16 MiB scoped-vmem limit (measured:
-    # 16.28M > 16.00M), so its q-block caps at 256 when grouped —
-    # gcd against t so an arbitrary caller block (e.g. 384) can never
-    # truncate rows out of the dk/dv accumulation.
+    # resident in VMEM; under Mosaic's default 16 MiB scoped limit that
+    # capped the q-block at 256 (r1-r4).  BWD_VMEM_LIMIT raises the
+    # ceiling, and the r5 interleaved A/B put the cap at 512 (see
+    # DKV_GROUPED_BQ_CAP) — gcd against t so an arbitrary caller block
+    # (e.g. 384) can never truncate rows out of the dk/dv accumulation.
     block_q_kv = (math.gcd(t, min(block_q, DKV_GROUPED_BQ_CAP))
                   if group_kv > 1 else block_q)
     num_q_blocks_kv = t // block_q_kv
@@ -335,17 +347,20 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
     def dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
                   dq_ref):
         qi = pl.program_id(2)
-        qb = q_ref[0].astype(jnp.float32)            # [bq, d]
-        dob = do_ref[0].astype(jnp.float32)          # [bq, d]
+        # input-dtype dots, f32 accumulation — see the forward kernel's
+        # note (bf16 feeds the MXU natively; scale folds into f32
+        # scores / ds instead of upcasting q)
+        qb = q_ref[0]                                # [bq, d]
+        dob = do_ref[0]                              # [bq, d]
         lse_b = lse_ref[0][:, 0:1]                   # [bq, 1]
         delta_b = delta_ref[0][:, 0:1]               # [bq, 1]
 
         def body(ki, dq_acc):
-            kb = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            kb = k_ref[0, pl.ds(ki * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(ki * block_k, block_k), :]
             sc = jax.lax.dot_general(
-                qb * scale, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [bq, bk]
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bq, bk]
             if causal:
                 qpos = causal_offset + qi * block_q + \
                     jax.lax.broadcasted_iota(
@@ -357,7 +372,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
             dp = jax.lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [bq, bk]
-            ds = p * (dp - delta_b) * scale
+            ds = (p * (dp - delta_b) * scale).astype(kb.dtype)
             return dq_acc + jax.lax.dot_general(
                 ds, kb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -375,21 +390,25 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
                    dk_ref, dv_ref):
         # q/do/lse/delta arrive as the full [group·t, ...] panel for
         # this (b, kv-head); row g·t + i is query head g's row i.
+        # Input-dtype dots, f32 accumulation (see forward) — the f32
+        # panel copies this kernel used to make were both the VMEM
+        # ceiling that capped block_q_kv at 256 and a multi-pass f32
+        # MXU tax.
         ki = pl.program_id(1)
-        kb = k_ref[0].astype(jnp.float32)            # [bk, d]
-        vb = v_ref[0].astype(jnp.float32)            # [bk, d]
+        kb = k_ref[0]                                # [bk, d]
+        vb = v_ref[0]                                # [bk, d]
 
         def make_body(goff):
             def body(qi, carry):
                 dk_acc, dv_acc = carry
                 rows = pl.ds(goff + qi * block_q_kv, block_q_kv)
-                qb = q_ref[0, rows, :].astype(jnp.float32)
-                dob = do_ref[0, rows, :].astype(jnp.float32)
+                qb = q_ref[0, rows, :]
+                dob = do_ref[0, rows, :]
                 lse_b = lse_ref[0, rows, 0:1]
                 delta_b = delta_ref[0, rows, 0:1]
                 sc = jax.lax.dot_general(
-                    qb * scale, kb, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)  # [bq, bk]
+                    qb, kb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
                 if causal:
                     qpos = causal_offset + qi * block_q_kv + \
                         jax.lax.broadcasted_iota(
@@ -399,12 +418,12 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
                     sc = jnp.where(qpos >= kpos, sc, NEG_INF)
                 p = jnp.exp(sc - lse_b)                  # [bq, bk]
                 dv_new = dv_acc + jax.lax.dot_general(
-                    p, dob, (((0,), (0,)), ((), ())),
+                    p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)  # [bk, d]
                 dp = jax.lax.dot_general(
                     dob, vb, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)  # [bq, bk]
-                ds = p * (dp - delta_b) * scale
+                ds = (p * (dp - delta_b) * scale).astype(qb.dtype)
                 dk_new = dk_acc + jax.lax.dot_general(
                     ds, qb, (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)  # [bk, d]
@@ -427,6 +446,12 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
         dk_ref[0] = dk.astype(dk_ref.dtype)
         dv_ref[0] = dv.astype(dv_ref.dtype)
 
+    if interpret:
+        cparams = {}
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+        cparams = {"compiler_params": pltpu.CompilerParams(
+            vmem_limit_bytes=BWD_VMEM_LIMIT)}
     qh_spec = pl.BlockSpec((1, block_q, d),
                            lambda i, g, j: (i * group + g, j, 0))
     lseh_spec = pl.BlockSpec((1, block_q, LSE_LANES),
@@ -445,6 +470,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
         ],
         out_specs=qh_spec,
         interpret=interpret,
+        **cparams,
     )(qf, kf, vf, lsef, delta, dof)
     # dkv reads the whole query group per (b, kv-head): view the
     # [b·h, t, ...] panels as [b·hkv, group·t, ...] (free reshape).
@@ -482,6 +508,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         ],
         interpret=interpret,
+        **cparams,
     )(qg, kkv, vkv, lseg, deltag, dog)
     if degroup_kv:   # sum the per-query-head dk/dv over each group
         dk = dk.reshape(b, hkv, group, s, d).sum(
