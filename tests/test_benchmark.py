@@ -34,8 +34,15 @@ class TestModelBench:
         fam = out["families"]
         assert set(fam) == {"moe_serving", "t5_serving", "lora",
                             "beam", "spec_decode", "spec_decode_pld",
+                            "spec_decode_pld_curve",
+                            "spec_decode_pld_break_even_acceptance",
                             "continuous_batching",
                             "continuous_batching_flagship"}
+        curve = fam["spec_decode_pld_curve"]
+        assert len(curve) >= 3
+        for p in curve:
+            assert 0 <= p["acceptance_rate"] <= 1
+            assert p["speedup_vs_greedy"] > 0
         for row in ("continuous_batching", "continuous_batching_flagship"):
             cb = fam[row]
             assert cb["e2e_tokens_per_s_anchored"] > 0
