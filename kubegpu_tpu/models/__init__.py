@@ -5,6 +5,7 @@ the MNIST MLP (inside workloads/programs)."""
 
 from kubegpu_tpu.models.decode import (
     beam_generate,
+    beam_generate_paged,
     decode_step,
     draft_view,
     greedy_generate,
@@ -65,7 +66,8 @@ __all__ = [
     "t5_greedy_generate", "t5_decode_step", "t5_init_decode_state",
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
-    "sample_generate", "beam_generate", "spec_generate", "draft_view",
+    "sample_generate", "beam_generate", "beam_generate_paged",
+    "spec_generate", "draft_view",
     "QTensor", "quantize_llama", "quantize_moe", "quantize_t5",
     "LoRAConfig", "lora_init", "lora_merge", "lora_param_specs",
     "make_lora_train_step",
