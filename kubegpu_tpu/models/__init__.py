@@ -47,6 +47,7 @@ from kubegpu_tpu.models.t5 import (
     t5_decode_step,
     t5_forward,
     t5_greedy_generate,
+    t5_greedy_generate_paged,
     t5_init,
     t5_init_decode_state,
     t5_param_specs,
@@ -63,7 +64,8 @@ __all__ = [
     "MoEConfig", "moe_forward", "moe_init", "moe_param_specs",
     "moe_prefill", "moe_decode_step", "moe_greedy_generate",
     "T5Config", "t5_forward", "t5_init", "t5_param_specs",
-    "t5_greedy_generate", "t5_decode_step", "t5_init_decode_state",
+    "t5_greedy_generate", "t5_greedy_generate_paged",
+    "t5_decode_step", "t5_init_decode_state",
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
     "sample_generate", "beam_generate", "beam_generate_paged",

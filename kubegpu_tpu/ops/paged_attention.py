@@ -321,6 +321,163 @@ def _paged_kernel_q8(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
     l_ref[0] = jnp.broadcast_to(l_f[..., None], (hkv, g, LSE_LANES))
 
 
+def _paged_kernel_bias(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
+                       qpos_ref, q_ref, pk_ref, pv_ref, table_ref,
+                       o_ref, m_ref, l_ref,
+                       kbuf, vbuf, sems, *, max_dist: int):
+    """Additive relative-position bias variant of :func:`_paged_kernel`
+    — the T5 decoder's self-attention on the pool.  ``table_ref`` is
+    the learned [H, n_buckets] bias table (VMEM-resident; tiny);
+    ``qpos_ref`` the per-row query position.  Buckets are computed
+    in-kernel from key physical positions with T5's causal log-spaced
+    rule (see models/t5.py:rel_pos_bucket) and the lookup is a one-hot
+    matmul — per-lane gathers don't vectorize on the VPU, a [P, nb]
+    one-hot against the table does."""
+    b = pl.program_id(0)
+    hkv, g, dd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    p = kbuf.shape[2]
+    nb = table_ref.shape[1]
+    layer = layer_ref[0]
+    tb, tpb, db = t_ref[b], tpad_ref[b], d_ref[b]
+    qpos = qpos_ref[b]
+    n_prompt = (tb + p - 1) // p
+    dstart = tpb // p
+    n_dec = (db + p - 1) // p
+    n_used = jnp.maximum(n_prompt + n_dec, 1)
+    max_exact = nb // 2
+    log_denom = jnp.log(max_dist / max_exact)
+
+    def rl_page(i):
+        return jnp.where(i < n_prompt, i, dstart + (i - n_prompt))
+
+    def dma_pair(i, slot):
+        pid = pt_ref[b, rl_page(i)]
+        return (pltpu.make_async_copy(pk_ref.at[layer, pid],
+                                      kbuf.at[slot], sems.at[slot, 0]),
+                pltpu.make_async_copy(pv_ref.at[layer, pid],
+                                      vbuf.at[slot], sems.at[slot, 1]))
+
+    def run(acc, m_i, l_i):
+        for d_ in dma_pair(0, 0):
+            d_.start()
+
+        def body(i, carry):
+            acc, m_prev, l_prev = carry
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < n_used)
+            def _prefetch():
+                for d_ in dma_pair(i + 1, 1 - slot):
+                    d_.start()
+
+            for d_ in dma_pair(i, slot):
+                d_.wait()
+            k = kbuf[slot]
+            v = vbuf[slot]
+            s = jax.lax.dot_general(
+                q_ref[0], k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * (dd ** -0.5)
+            phys = (rl_page(i) * p
+                    + jax.lax.broadcasted_iota(jnp.int32, (1, 1, p), 2))
+            # T5 causal bucket of rel = phys - qpos: n = max(qpos-phys,0)
+            n = jnp.maximum(qpos - phys[0, 0], 0)          # [P]
+            val_large = max_exact + (
+                jnp.log(jnp.maximum(n, 1).astype(jnp.float32)
+                        / max_exact) / log_denom
+                * (nb - max_exact)).astype(jnp.int32)
+            bucket = jnp.where(n < max_exact, n,
+                               jnp.minimum(val_large, nb - 1))   # [P]
+            onehot = (bucket[:, None] == jax.lax.broadcasted_iota(
+                jnp.int32, (p, nb), 1)).astype(jnp.float32)
+            bias = jax.lax.dot_general(
+                table_ref[...], onehot, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [H, P]
+            s = s + bias[:, None, :]
+            valid = (phys < tb) | ((phys >= tpb) & (phys < tpb + db))
+            s = jnp.where(valid, s, NEG_INF)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            w = jnp.where(valid, jnp.exp(s - m_new[..., None]), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(w, axis=-1)
+            pv_ = jax.lax.dot_general(
+                w.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            return acc * alpha[..., None] + pv_, m_new, l_new
+
+        return jax.lax.fori_loop(0, n_used, body, (acc, m_i, l_i))
+
+    acc0 = jnp.zeros((hkv, g, dd), jnp.float32)
+    m0 = jnp.full((hkv, g), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hkv, g), jnp.float32)
+    acc, m_f, l_f = run(acc0, m0, l0)
+    norm = jnp.maximum(l_f, 1e-30)[..., None]
+    o_ref[0] = acc / norm
+    m_ref[0] = jnp.broadcast_to(m_f[..., None], (hkv, g, LSE_LANES))
+    l_ref[0] = jnp.broadcast_to(l_f[..., None], (hkv, g, LSE_LANES))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "bias_max_dist"))
+def paged_attention_biased(q: jax.Array, pool_k: jax.Array,
+                           pool_v: jax.Array, page_table: jax.Array,
+                           layer: jax.Array, t: jax.Array,
+                           t_pad: jax.Array, d: jax.Array,
+                           q_pos: jax.Array, bias_table: jax.Array,
+                           bias_max_dist: int,
+                           interpret: bool = False
+                           ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`paged_attention` plus T5's causal relative-position bias:
+    ``bias_table`` [H, n_buckets] (f32), ``q_pos`` [B] the query's
+    global position per row, ``bias_max_dist`` the bucketing horizon.
+    Same partials contract; used by the T5 decoder's paged self-attn
+    (its cross-attention has no bias and stays dense)."""
+    b, hq, dd = q.shape
+    hkv, p = pool_k.shape[2], pool_k.shape[3]
+    g = hq // hkv
+    if hq % hkv:
+        raise ValueError(f"Hq {hq} not a multiple of Hkv {hkv}")
+    out, m, l = pl.pallas_call(
+        functools.partial(_paged_kernel_bias, max_dist=bias_max_dist),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, hkv, g, dd),
+                             lambda bb, *_: (bb, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(bias_table.shape,
+                             lambda bb, *_: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, hkv, g, dd),
+                             lambda bb, *_: (bb, 0, 0, 0)),
+                pl.BlockSpec((1, hkv, g, LSE_LANES),
+                             lambda bb, *_: (bb, 0, 0, 0)),
+                pl.BlockSpec((1, hkv, g, LSE_LANES),
+                             lambda bb, *_: (bb, 0, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, hkv, p, dd), pool_k.dtype),
+                pltpu.VMEM((2, hkv, p, dd), pool_v.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, g, dd), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, g, LSE_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, g, LSE_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.atleast_1d(layer).astype(jnp.int32), page_table,
+      t.astype(jnp.int32), t_pad.astype(jnp.int32),
+      d.astype(jnp.int32), q_pos.astype(jnp.int32),
+      q.reshape(b, hkv, g, dd), pool_k, pool_v,
+      bias_table.astype(jnp.float32))
+    return (out.reshape(b, hq, dd), m[..., 0].reshape(b, hq),
+            l[..., 0].reshape(b, hq))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
                     page_table: jax.Array, layer: jax.Array,

@@ -117,3 +117,48 @@ def test_merge_partials_equals_joint_softmax():
     w = np.exp(s - s.max(-1, keepdims=True))
     joint = np.einsum("bhs,bhsd->bhd", w / w.sum(-1, keepdims=True), v)
     assert np.allclose(merged, joint, atol=1e-6)
+
+
+class TestBiasedKernel:
+    """paged_attention_biased: T5's causal rel-pos bias added in-kernel
+    (bucketed one-hot matmul against the learned table)."""
+
+    def test_matches_gather_oracle(self, state):
+        from kubegpu_tpu.models.t5 import rel_pos_bucket
+        from kubegpu_tpu.ops.paged_attention import paged_attention_biased
+        pool_k, pool_v, q, pt, t, tpad, d = state
+        rng = np.random.default_rng(3)
+        nb, max_dist = 8, 32
+        table = jnp.asarray(rng.normal(size=(HQ, nb)), jnp.float32)
+        # MHA (T5): Hkv == Hq in this oracle — regroup the pool
+        pool_k4 = jnp.repeat(pool_k, HQ // HKV, axis=2)
+        pool_v4 = jnp.repeat(pool_v, HQ // HKV, axis=2)
+        qpos = jnp.asarray([9, 13, 0], jnp.int32)
+        o_k, m_k, l_k = paged_attention_biased(
+            q, pool_k4, pool_v4, pt, jnp.int32(1), t, tpad, d,
+            qpos, table, bias_max_dist=max_dist, interpret=True)
+        # dense oracle: gather pages, add bias, masked softmax partials
+        s_len = MAX_PAGES * P
+        kl = np.asarray(jnp.take(pool_k4, 1, axis=0))
+        vl = np.asarray(jnp.take(pool_v4, 1, axis=0))
+        k = kl[np.asarray(pt)].transpose(0, 2, 1, 3, 4).reshape(
+            B, HQ, s_len, D)
+        v = vl[np.asarray(pt)].transpose(0, 2, 1, 3, 4).reshape(
+            B, HQ, s_len, D)
+        s = np.einsum("bhd,bhsd->bhs", np.asarray(q), k) * D ** -0.5
+        phys = np.arange(s_len)
+        for b in range(B):
+            rel = jnp.asarray(phys - int(qpos[b]))
+            bucket = np.asarray(rel_pos_bucket(rel, False, nb, max_dist))
+            s[b] += np.asarray(table)[:, bucket]
+            valid = (phys < int(t[b])) | ((phys >= int(tpad[b]))
+                                          & (phys < int(tpad[b] + d[b])))
+            s[b][:, ~valid] = -1e30
+        m = s.max(-1)
+        w = np.where(s > -1e29, np.exp(s - m[..., None]), 0.0)
+        l = w.sum(-1)
+        o = np.einsum("bhs,bhsd->bhd", w, v) / np.maximum(
+            l, 1e-30)[..., None]
+        assert np.allclose(np.asarray(o_k[:2]), o[:2], atol=1e-5)
+        assert np.allclose(np.asarray(l_k[:2]), l[:2], atol=1e-4)
+        assert np.allclose(np.asarray(o_k[2]), 0.0)
