@@ -1136,6 +1136,11 @@ def run_serve_pod_bench(timeout_s: float = 600.0) -> dict:
     extra = {k: v for k, v in os.environ.items()
              if k.startswith(("JAX_", "TPU_", "PJRT_", "LIBTPU"))
              and k not in ("JAX_PLATFORMS",)}
+    # the strict fence rides into the pod: the flagship serving
+    # workload must abort on a silent paged→dense degradation too
+    if os.environ.get("KUBETPU_REQUIRE_PALLAS"):
+        extra["KUBETPU_REQUIRE_PALLAS"] = \
+            os.environ["KUBETPU_REQUIRE_PALLAS"]
     cl = SimCluster(["v4-8"], real_processes=True, extra_env=extra)
     pods, _ = ALL_CONFIGS["serve"]()
     for p in pods:

@@ -10,9 +10,12 @@ fence makes that regression class *fail* instead of merely warn:
   would-be-silent fallback — flash-attention block misalignment,
   paged→dense engine degradation — into a raised
   :class:`StrictFallbackError`.
-- ``bench.py`` and the flagship workloads export the flag, so a future
-  shape/layout change that quietly de-optimizes the hot path aborts the
-  bench run instead of recording a plausible-but-wrong number.
+- ``bench.py`` exports the flag for its whole run, and the serve-pod
+  bench forwards it into the scheduled flagship pod's env, so a future
+  shape/layout change that quietly de-optimizes a hot path aborts the
+  bench instead of recording a plausible-but-wrong number.  (Tiny smoke
+  configs run permissive: their prompt buckets legitimately don't align
+  to pages.)
 
 The flag is read at trace time (these decisions are static on shapes),
 so flipping it mid-process affects new shapes only — jit caches keyed on
