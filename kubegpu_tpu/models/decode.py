@@ -1119,6 +1119,222 @@ def _pld_fused_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
     return run
 
 
+def _chunk_causal_partials(q: jax.Array, k: jax.Array, v: jax.Array
+                           ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Causal softmax partials of a verify chunk over its OWN keys.
+    q: [B, Hq, C, D]; k/v: [B, Hkv, C, D].  Returns flattened
+    (o [B, Hq·C, D] normalized f32, m, l [B, Hq·C]) in the
+    (hkv, group, c)-major order the paged kernel's folded-group
+    output uses, so the two merge positionally."""
+    b, hq, c, d = q.shape
+    hkv = k.shape[1]
+    g = hq // hkv
+    qg = q.reshape(b, hkv, g, c, d)
+    s = jnp.einsum("bkgcd,bksd->bkgcs", qg, k.astype(q.dtype),
+                   preferred_element_type=jnp.float32) * (d ** -0.5)
+    causal = (jnp.arange(c)[:, None] >= jnp.arange(c)[None, :])
+    s = jnp.where(causal[None, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    w = jnp.where(causal[None, None, None],
+                  jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(w, axis=-1)
+    o = jnp.einsum("bkgcs,bksd->bkgcd", w.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    return (o.reshape(b, hq * c, d), m.reshape(b, hq * c),
+            l.reshape(b, hq * c))
+
+
+def _paged_chunk_forward(params: dict, chunk: jax.Array, pool: dict,
+                         pt: jax.Array, pos, cfg: LlamaConfig,
+                         page_size: int, npg_row: int,
+                         interpret: bool) -> tuple[jax.Array, dict]:
+    """The speculative verify forward with the KV history on a page
+    pool: the chunk's C=γ+1 queries FOLD into the paged kernel's group
+    dim (their history validity [0, pos) is uniform — in-chunk
+    causality lives in :func:`_chunk_causal_partials` and merges via
+    flash-decoding partials), and the chunk's fresh K/V lands in a
+    static 2-page window at offset ``pos`` (each row's pages are
+    pool-contiguous, so the window is two ``dynamic_update_slice``
+    pages — rejected entries simply stay masked by the next
+    iteration's smaller ``d``).  Returns (logits [B, C, V], pool')."""
+    from kubegpu_tpu.ops.paged_attention import (
+        merge_partials,
+        paged_attention,
+    )
+    b, c = chunk.shape
+    hkv = cfg.n_kv_heads
+    hd = cfg.head_dim
+    p = page_size
+    x = jnp.take(params["embed"], chunk, axis=0)
+    q_pos = pos + jnp.arange(c)
+    positions = jnp.broadcast_to(q_pos[None, :], (b, c))
+    d0 = jnp.full((b,), pos, jnp.int32)
+    zeros_b = jnp.zeros((b,), jnp.int32)
+    off = pos % p
+    page_a = pos // p
+
+    def layer(x, xs):
+        lp, pk, pv = xs            # this layer's [n_pool, Hkv, P, D]
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(h, lp, cfg, positions)   # [B, H, C, D]
+
+        def wrow(r, kv):
+            pk, pv = kv
+            base = 1 + r * npg_row + page_a
+
+            def put(pw, seg):
+                win = lax.dynamic_slice(pw, (base, 0, 0, 0),
+                                        (2, hkv, p, hd))
+                win = win.transpose(1, 0, 2, 3).reshape(hkv, 2 * p, hd)
+                win = lax.dynamic_update_slice(
+                    win, seg.astype(win.dtype), (0, off, 0))
+                win = win.reshape(hkv, 2, p, hd).transpose(1, 0, 2, 3)
+                return lax.dynamic_update_slice(pw, win,
+                                                (base, 0, 0, 0))
+
+            return put(pk, k[r]), put(pv, v[r])
+
+        pk, pv = lax.fori_loop(0, b, wrow, (pk, pv))
+        qflat = q.reshape(b, cfg.n_heads * c, hd)   # (hkv, g, c)-major
+        o_p, m_p, l_p = paged_attention(
+            qflat, pk[None], pv[None], pt, jnp.int32(0), zeros_b,
+            zeros_b, d0, interpret=interpret)
+        o_c, m_c, l_c = _chunk_causal_partials(q, k, v)
+        o = merge_partials(o_p, m_p, l_p, o_c, m_c, l_c)
+        o = o.reshape(b, cfg.n_heads, c, hd).astype(x.dtype)
+        return _attn_finish(
+            x, o, lp, cfg,
+            lambda x_, lp_: _dense_ffn(x_, lp_, cfg)), (pk, pv)
+
+    x, (pk_new, pv_new) = lax.scan(
+        layer, x, (params["layers"], pool["k"], pool["v"]))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": pk_new, "v": pv_new}
+
+
+@functools.lru_cache(maxsize=16)
+def _pld_paged_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
+                  gamma: int, ngram: int, page_size: int,
+                  interpret: bool):
+    """:func:`_pld_fused_fn` with the KV history on a page pool —
+    the last decode family off the paged regime (VERDICT r4 weak #6).
+    Same lookup/accept machinery; the cache machinery is swapped for
+    :func:`_paged_chunk_forward` over a pool built from the prefill
+    panel (contiguous pages per row + one spare page so the verify
+    chunk's 2-page write window never runs off the region)."""
+    clen = max_len + gamma
+    npg_row = -(-clen // page_size) + 1
+    region = npg_row * page_size
+    width = n_steps + gamma + 1
+    seqlen = t + width
+    slots = jnp.arange(gamma + 1)
+
+    @jax.jit
+    def run(params, prompt):
+        b = prompt.shape[0]
+        logits, fcache = prefill(params, prompt, cfg, region)
+        L, _, hkv, _, hd = fcache["k"].shape
+
+        def paginate(panel):
+            pages = panel.reshape(L, b, hkv, npg_row, page_size, hd) \
+                .transpose(0, 1, 3, 2, 4, 5) \
+                .reshape(L, b * npg_row, hkv, page_size, hd)
+            trash = jnp.zeros((L, 1, hkv, page_size, hd), pages.dtype)
+            return jnp.concatenate([trash, pages], axis=1)
+
+        pool = {"k": paginate(fcache["k"]), "v": paginate(fcache["v"])}
+        pt = (1 + jnp.arange(b)[:, None] * npg_row
+              + jnp.arange(npg_row)[None, :]).astype(jnp.int32)
+        cur = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        out = jnp.zeros((b, width), prompt.dtype).at[:, 0].set(cur)
+
+        def lookup(seq, pos):
+            w = jax.vmap(
+                lambda s: lax.dynamic_slice(s, (pos - ngram + 1,),
+                                            (ngram,)))(seq)
+            m = jnp.ones(seq.shape, bool)
+            for k_ in range(ngram):
+                shift = ngram - 1 - k_
+                shifted = jnp.pad(seq, ((0, 0), (shift, 0)))[:, :seqlen] \
+                    if shift else seq
+                m &= shifted == w[:, k_:k_ + 1]
+            i = jnp.arange(seqlen)[None, :]
+            cand = (i >= ngram - 1) & (i < pos)
+            i_match = jnp.max(jnp.where(m & cand, i, -1), axis=1)
+            found = i_match >= 0
+            start = jnp.maximum(i_match + 1, 0)
+            cont = jax.vmap(
+                lambda s, st: lax.dynamic_slice(s, (st,), (gamma,)))(
+                seq, start)
+            last = jax.vmap(
+                lambda s: lax.dynamic_slice(s, (pos,), (1,)))(seq)
+            return jnp.where(found[:, None], cont,
+                             jnp.broadcast_to(last, cont.shape))
+
+        def cond(c):
+            return c[1] < n_steps
+
+        def body(c):
+            out, n_out, cur, pos, pool, iters, acc, prop = c
+            seq = jnp.concatenate([prompt, out], axis=1)
+            drafted = lookup(seq, pos)
+            chunk = jnp.concatenate([cur[:, None], drafted], axis=1)
+            vlogits, pool = _paged_chunk_forward(
+                params, chunk, pool, pt, pos, cfg, page_size, npg_row,
+                interpret)
+            f = jnp.argmax(vlogits, axis=-1).astype(cur.dtype)
+            match = (drafted == f[:, :gamma]).astype(jnp.int32)
+            j = jnp.cumprod(match, axis=1).sum(axis=1).min()
+            take = jnp.minimum(j, n_steps - n_out - 1)
+            corr = lax.dynamic_index_in_dim(f, take, axis=1,
+                                            keepdims=False)
+            padded = jnp.concatenate([drafted, drafted[:, -1:]], axis=1)
+            emit = jnp.where(slots[None, :] < take, padded,
+                             corr[:, None])
+            out = lax.dynamic_update_slice(out, emit, (0, n_out))
+            prop_i = jnp.minimum(gamma, n_steps - n_out - 1)
+            return (out, n_out + take + 1, corr, pos + take + 1,
+                    pool, iters + 1, acc + take, prop + prop_i)
+
+        init = (out, jnp.int32(1), cur, jnp.int32(t), pool,
+                jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        out, _, _, _, _, iters, acc, prop = lax.while_loop(
+            cond, body, init)
+        return out[:, :n_steps], iters, acc, prop
+
+    return run
+
+
+def pld_generate_paged(params: dict, prompt: jax.Array, n_steps: int,
+                       cfg: LlamaConfig, gamma: int = 8,
+                       ngram: int = 3, max_len: int | None = None,
+                       page_size: int = 128
+                       ) -> tuple[jax.Array, dict]:
+    """:func:`pld_generate_fused` with the KV history on a page pool
+    read by the paged-attention kernel (the chunk's queries fold into
+    the kernel's group dim).  Same contract and stats."""
+    t = prompt.shape[1]
+    max_len = _validate_rollout(cfg, t, n_steps, max_len)
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+    interpret = jax.devices()[0].platform == "cpu"
+    toks, iters, acc, prop = _pld_paged_fn(
+        cfg, t, n_steps, max_len, gamma, ngram, page_size, interpret)(
+        params, prompt)
+    import numpy as np
+    iters, acc, prop = (int(x) for x in
+                        np.asarray(jnp.stack([iters, acc, prop])))
+    stats = {
+        "iterations": iters,
+        "acceptance_rate": (acc / prop) if prop else 0.0,
+    }
+    return toks, stats
+
+
 def pld_generate_fused(params: dict, prompt: jax.Array, n_steps: int,
                        cfg: LlamaConfig, gamma: int = 8,
                        ngram: int = 3, max_len: int | None = None,
