@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native asan test bench clean
+.PHONY: all native asan test bench bench-smoke clean
 
 all: native
 
@@ -20,6 +20,9 @@ test:
 
 bench:
 	$(PY) bench.py
+
+bench-smoke:                    # serving bench legs at tiny CPU configs
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py -q
 
 clean:
 	$(MAKE) -C kubegpu_tpu/allocator/csrc clean

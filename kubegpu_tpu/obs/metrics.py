@@ -126,6 +126,22 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+def percentiles(values, ps=(50, 90, 99)) -> dict:
+    """Percentile summary of a plain value list without registering a
+    histogram — same index math as :class:`_Histogram`.  Used by the
+    serving engine's per-tick decode-stall list
+    (``ContinuousBatcher.stall_ms``) and the bench's device-anchored
+    stall distributions, so engine and bench quantiles can never
+    disagree on method."""
+    h = _Histogram()
+    for v in values:
+        h.observe(float(v))
+    out = {"count": h.count, "mean": h.mean}
+    for p in ps:
+        out[f"p{int(p)}"] = h.percentile(p)
+    return out
+
+
 global_registry = MetricsRegistry()
 
 

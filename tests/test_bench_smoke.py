@@ -1,0 +1,40 @@
+"""``make bench-smoke``: the serving fast-path bench legs must run at
+tiny CPU scale in seconds, produce a JSON-serializable document, and
+carry the keys the driver's acceptance gates read (prefill reduction,
+pages saved, stall p99 on/off, equal-HBM paged-vs-dense) — wired into
+tier-1 so a key rename or a broken leg fails before a hardware run,
+not during one (the r4 "claim lives where the driver doesn't look"
+failure mode, preempted)."""
+
+import json
+
+from kubegpu_tpu.benchmark import run_serving_bench_smoke
+
+
+def test_serving_bench_smoke_parses_and_carries_keys():
+    out = run_serving_bench_smoke()
+    doc = json.loads(json.dumps(out))   # must round-trip as JSON
+
+    pc = doc["cb_prefix_cache"]
+    assert pc["prefill_reduction_x"] > 1.0      # sharing actually paid
+    assert pc["pages_aliased"] >= 1
+    assert pc["prefill_tokens_actual"] < pc["prefill_tokens_naive"]
+    assert pc["prefill_tokens_saved"] == pc["pages_aliased"] * 8
+    assert pc["requests_completed"] == pc["n_way"]
+
+    st = doc["cb_chunked_stall"]
+    for leg in ("off", "on"):
+        assert st[leg]["stall_ms_anchored"]["p99"] > 0
+        assert st[leg]["stall_ms_host_proxy"]["count"] == \
+            st[leg]["ticks"]
+    assert st["off"]["wave_cost_ms"]            # off ran real waves
+    assert st["on"]["chunk_cost_ms"] > 0        # on ran real chunks
+    assert "stall_p99_reduction_x" in st
+
+    eh = doc["cb_equal_hbm"]
+    assert eh["protocol"] == "equal_hbm_mixed_length"
+    assert eh["paged_slots"] > eh["dense_slots"]
+    for leg in ("dense", "paged"):
+        assert eh[leg]["e2e_tokens_per_s_anchored"] > 0
+        assert eh[leg]["tokens"] > 0
+    assert eh["paged_vs_dense_equal_hbm"] > 0

@@ -22,11 +22,11 @@ def tiny():
     return cfg, params
 
 
-def make_engine(cfg, params, total_pages=None, n_slots=3):
+def make_engine(cfg, params, total_pages=None, n_slots=3, **kw):
     return ContinuousBatcher(
         params, cfg, n_slots=n_slots, max_len=32, stride=2,
         prompt_buckets=(8, 16), paged=True, page_size=8,
-        total_pages=total_pages)
+        total_pages=total_pages, **kw)
 
 
 def check_pool_invariants(eng):
@@ -51,6 +51,48 @@ def check_pool_invariants(eng):
         if slot not in eng._slot_pages:
             assert (eng._pt[slot] == 0).all(), \
                 f"retired slot {slot} kept a live page table"
+
+
+def check_refcount_invariants(eng):
+    """The MULTI-OWNER pool truths (prefix caching): a page may have
+    several owners, but the partition law survives —
+    (1) free ∪ allocated is exactly {1..total_pages}, disjoint;
+    (2) every allocated page's refcount equals the number of slots
+        whose page list contains it (an alias is a reference, never a
+        copy);
+    (3) a refcount-0 page exists only while registered in the prefix
+        cache (retained for reuse, reclaimable under pressure);
+    (4) trash page 0 is never allocated, never cached;
+    (5) live table rows list exactly the slot's pages; retired rows
+        are zeroed."""
+    allocated = set(eng._page_refs)
+    assert 0 not in allocated and 0 not in eng._page_key
+    assert not (set(eng._free_pages) & allocated), \
+        "page simultaneously free and allocated"
+    assert set(eng._free_pages) | allocated == \
+        set(range(1, eng.total_pages + 1)), "page leak or forgery"
+    owners: dict[int, int] = {}
+    for pages in eng._slot_pages.values():
+        assert len(pages) == len(set(pages)), \
+            "slot references a page twice"
+        for p in pages:
+            owners[p] = owners.get(p, 0) + 1
+    for p in allocated:
+        assert eng._page_refs[p] == owners.get(p, 0), \
+            f"page {p}: refcount {eng._page_refs[p]} != " \
+            f"{owners.get(p, 0)} owners"
+        if eng._page_refs[p] == 0:
+            assert p in eng._page_key, \
+                f"unreferenced page {p} retained but not cached"
+    for p, key in eng._page_key.items():
+        assert eng._prefix_cache.get(key) == p
+    for slot, pages in eng._slot_pages.items():
+        row = eng._pt[slot]
+        assert list(row[:len(pages)]) == pages
+        assert (row[len(pages):] == 0).all()
+    for slot in range(eng.n_slots):
+        if slot not in eng._slot_pages:
+            assert (eng._pt[slot] == 0).all()
 
 
 class TestPagePoolFuzz:
@@ -151,3 +193,143 @@ class TestPagePoolFuzz:
         assert [r.rid for r in done] == [rb]
         assert done[0].tokens == ref["b"]
         check_pool_invariants(eng)
+
+
+class TestRefcountedPrefixPool:
+    """Multi-owner refcount semantics (ISSUE 1 tentpole): aliasing,
+    release order, last-owner frees, cached retention, LRU
+    reclamation — checked with the refcount-aware partition
+    invariants after every step."""
+
+    def _mk(self, cfg, params, **kw):
+        kw.setdefault("prefix_cache", True)
+        kw.setdefault("prefill_chunk", 8)
+        return make_engine(cfg, params, **kw)
+
+    def _shared_prompts(self, cfg, n, plen=12):
+        """Prompts sharing the first full page (8 tokens at P=8) but
+        differing afterwards."""
+        shared = [(i * 5 + 3) % cfg.vocab_size for i in range(8)]
+        return [shared + [(31 + 7 * j + i) % cfg.vocab_size
+                          for i in range(plen - 8)]
+                for j in range(n)]
+
+    def test_alias_refcount_and_partition(self, tiny):
+        cfg, params = tiny
+        eng = self._mk(cfg, params)
+        pa, pb, pc = self._shared_prompts(cfg, 3)
+        eng.submit(pa, 6)
+        eng.step()                   # leader admits + registers
+        check_refcount_invariants(eng)
+        eng.submit(pb, 6)
+        eng.submit(pc, 6)
+        saw_multi = False
+        ticks = 0
+        while (eng.queue or eng.slot_req) and ticks < 200:
+            eng.step()
+            check_refcount_invariants(eng)
+            saw_multi = saw_multi or any(
+                r > 1 for r in eng._page_refs.values())
+            ticks += 1
+        assert saw_multi, "no page was ever multi-owned"
+        assert eng.prefix_hits == 2
+        assert eng.pages_aliased == 2
+
+    def test_release_order_last_owner_frees(self, tiny):
+        """Retire the LEADER while a sharer still decodes: the shared
+        page must survive (ref 2 → 1), and only after the last owner
+        retires drop to ref 0 — retained in the cache, not freed."""
+        cfg, params = tiny
+        eng = self._mk(cfg, params, n_slots=2)
+        pa, pb = self._shared_prompts(cfg, 2)
+        ra = eng.submit(pa, 4)       # leader: short generation
+        eng.step()
+        eng.submit(pb, 12)           # sharer: long generation
+        done = []
+        shared_page = None
+        ticks = 0
+        while (eng.queue or eng.slot_req) and ticks < 200:
+            done.extend(eng.step())
+            check_refcount_invariants(eng)
+            for p, r in eng._page_refs.items():
+                if r > 1:
+                    shared_page = p
+            ticks += 1
+        assert shared_page is not None
+        assert done and done[0].rid == ra, "leader retired first"
+        # after full drain: last owner released, page cached at ref 0
+        assert eng._page_refs.get(shared_page) == 0
+        assert shared_page in eng._page_key
+        assert shared_page not in eng._free_pages
+        check_refcount_invariants(eng)
+
+    def test_cached_page_reused_after_all_owners_gone(self, tiny):
+        """Sequential (non-overlapping) traffic still hits: the cached
+        page outlives its owners and the next same-prefix request
+        aliases it instead of re-prefilling."""
+        cfg, params = tiny
+        eng = self._mk(cfg, params)
+        pa, pb = self._shared_prompts(cfg, 2)
+        eng.submit(pa, 4)
+        eng.drain()
+        before = eng.prefill_tokens
+        eng.submit(pb, 4)
+        eng.drain()
+        check_refcount_invariants(eng)
+        assert eng.prefix_hits == 1
+        # the sharer prefilled only its tail (12 - 8 = 4 valid tokens)
+        assert eng.prefill_tokens - before == 4
+
+    def test_lru_eviction_reclaims_cached_pages(self, tiny):
+        """Cached refcount-0 pages are capacity, not a leak: a pool
+        sized so the cached page must be reclaimed still serves a
+        non-matching request, and the registry entry is dropped."""
+        cfg, params = tiny
+        # bucket 16 + 4 new @ stride 2 -> 2 prompt pages + 1 decode
+        eng = self._mk(cfg, params, total_pages=3, n_slots=1)
+        pa, pb = self._shared_prompts(cfg, 2)
+        eng.submit(pa, 4)
+        eng.drain()
+        assert len(eng._prefix_cache) == 1       # one page cached
+        cached = next(iter(eng._prefix_cache.values()))
+        # different FIRST page: no hit, needs all 3 pages -> eviction
+        pc = [(i * 11 + 9) % cfg.vocab_size for i in range(12)]
+        eng.submit(pc, 4)
+        eng.drain()
+        check_refcount_invariants(eng)
+        assert cached not in eng._page_key       # registry dropped it
+        assert len(eng._free_pages) + len(eng._page_refs) == 3
+
+    def test_churn_with_prefix_cache_no_leak(self, tiny):
+        """The original fuzz churn, refcount edition: random mixed
+        traffic (some sharing prefixes) through a cache-enabled
+        engine; partition invariants hold every tick and every request
+        finishes exactly."""
+        cfg, params = tiny
+        rng = np.random.default_rng(7)
+        eng = self._mk(cfg, params)
+        shared = [(i * 5 + 3) % cfg.vocab_size for i in range(8)]
+        want, done = {}, {}
+        for _ in range(80):
+            if rng.random() < 0.5 and len(eng.queue) < 4:
+                new = int(rng.integers(1, 6))
+                if rng.random() < 0.5:
+                    plen = int(rng.integers(9, 16))
+                    prompt = shared + list(
+                        rng.integers(0, cfg.vocab_size, plen - 8))
+                else:
+                    plen = int(rng.integers(1, 16))
+                    prompt = list(
+                        rng.integers(0, cfg.vocab_size, plen))
+                want[eng.submit(prompt, new)] = new
+            for r in eng.step():
+                done[r.rid] = len(r.tokens)
+            check_refcount_invariants(eng)
+        for r in eng.drain():
+            done[r.rid] = len(r.tokens)
+        check_refcount_invariants(eng)
+        assert done == want
+        assert not eng._slot_pages
+        # every non-cached page back on the free list
+        assert len(eng._free_pages) + len(eng._page_refs) == \
+            eng.total_pages

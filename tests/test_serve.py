@@ -359,3 +359,149 @@ class TestMoEOnEngine:
         done = eng.drain()
         assert [r.rid for r in done] == [rid]
         assert len(done[0].tokens) == 6
+
+
+class TestServingFastPath:
+    """Prefix caching + chunked prefill (ISSUE 1 tentpole): exact
+    token parity against the solo dense path AND the plain paged
+    engine, for shared-prefix and chunked-prefill admissions."""
+
+    def _eng(self, params, cfg, **kw):
+        kw.setdefault("n_slots", 2)
+        kw.setdefault("stride", 4)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("paged", True)
+        kw.setdefault("page_size", 8)
+        return ContinuousBatcher(params, cfg, **kw)
+
+    def test_chunked_prefill_matches_greedy(self, tiny):
+        """Multi-chunk admissions (bucket 16, chunk 8) interleaved
+        with single-chunk wave admissions (bucket 8), staggered
+        mid-flight — every request bit-identical to solo greedy."""
+        cfg, params = tiny
+        eng = self._eng(params, cfg, chunked_prefill=True,
+                        prefill_chunk=8)
+        prompts = [
+            ([(i * 3 + 1) % cfg.vocab_size for i in range(13)], 9),
+            ([(i * 5 + 2) % cfg.vocab_size for i in range(5)], 7),
+            ([(i * 11 + 3) % cfg.vocab_size for i in range(15)], 8),
+            ([(i * 13 + 4) % cfg.vocab_size for i in range(9)], 5),
+        ]
+        rids = {}
+        for p, n in prompts[:2]:
+            rids[eng.submit(p, n)] = (p, n)
+        eng.step()
+        for p, n in prompts[2:]:
+            rids[eng.submit(p, n)] = (p, n)
+        done = {r.rid: r for r in eng.drain()}
+        assert set(done) == set(rids)
+        for rid, (p, n) in rids.items():
+            assert done[rid].tokens == solo(params, p, n, cfg), rid
+        assert eng.chunks_run >= 2      # the long prompts went chunked
+
+    def test_shared_prefix_matches_greedy_and_plain_paged(self, tiny):
+        """N-way shared-prefix traffic: followers alias the leader's
+        pages and prefill only tails, yet every output matches BOTH
+        the solo dense path and a plain paged engine with no caching
+        (same tokens, fewer prefilled)."""
+        cfg, params = tiny
+        shared = [(i * 5 + 3) % cfg.vocab_size for i in range(8)]
+        prompts = [(shared + [(41 + 9 * j + i) % cfg.vocab_size
+                              for i in range(5)], 6) for j in range(3)]
+        eng = self._eng(params, cfg, n_slots=3, prefix_cache=True,
+                        prefill_chunk=8)
+        plain = self._eng(params, cfg, n_slots=3)
+        rids, rids_p = {}, {}
+        (p0, n0) = prompts[0]
+        rids[eng.submit(p0, n0)] = (p0, n0)
+        eng.step()                       # leader registers its page
+        for p, n in prompts[1:]:
+            rids[eng.submit(p, n)] = (p, n)
+        for p, n in prompts:
+            rids_p[plain.submit(p, n)] = (p, n)
+        done = {r.rid: r.tokens for r in eng.drain()}
+        done_p = {r.rid: r.tokens for r in plain.drain()}
+        for rid, (p, n) in rids.items():
+            assert done[rid] == solo(params, p, n, cfg), rid
+        for rid, (p, n) in rids_p.items():
+            assert done_p[rid] == solo(params, p, n, cfg), rid
+        assert eng.prefix_hits == 2
+        assert eng.pages_aliased == 2
+        assert eng.prefill_tokens_saved == 16
+        # the cached engine did strictly less prefill work
+        assert eng.prefill_tokens < plain.prefill_tokens
+
+    def test_shared_prefix_with_chunked_long_prompts(self, tiny):
+        """Both features composed: 15-token prompts sharing one full
+        page, chunked admission for leader AND tails."""
+        cfg, params = tiny
+        shared = [(i * 7 + 2) % cfg.vocab_size for i in range(8)]
+        prompts = [(shared + [(61 + 5 * j + i) % cfg.vocab_size
+                              for i in range(7)], 5) for j in range(2)]
+        eng = self._eng(params, cfg, prefix_cache=True,
+                        chunked_prefill=True, prefill_chunk=8)
+        (p0, n0) = prompts[0]
+        rids = {eng.submit(p0, n0): (p0, n0)}
+        done = {}
+        for _ in range(3):               # leader needs 2 chunk ticks
+            done.update({r.rid: r.tokens for r in eng.step()})
+        (p1, n1) = prompts[1]
+        rids[eng.submit(p1, n1)] = (p1, n1)
+        done.update({r.rid: r.tokens for r in eng.drain()})
+        for rid, (p, n) in rids.items():
+            assert done[rid] == solo(params, p, n, cfg), rid
+        assert eng.prefix_hits == 1
+
+    def test_single_token_request_chunked(self, tiny):
+        """max_new_tokens=1 through the chunk path: the final chunk's
+        pick IS the answer; the request retires without decoding."""
+        cfg, params = tiny
+        eng = self._eng(params, cfg, chunked_prefill=True,
+                        prefill_chunk=8)
+        p = [(i * 9 + 1) % cfg.vocab_size for i in range(11)]
+        rid = eng.submit(p, 1)
+        done = eng.drain()
+        assert [r.rid for r in done] == [rid]
+        assert done[0].tokens == solo(params, p, 1, cfg)
+
+    def test_stall_tracking_populated(self, tiny):
+        cfg, params = tiny
+        eng = self._eng(params, cfg, chunked_prefill=True,
+                        prefill_chunk=8)
+        eng.submit([(i * 3) % cfg.vocab_size for i in range(13)], 4)
+        eng.drain()
+        assert eng.stall_ms and all(s >= 0 for s in eng.stall_ms)
+        assert eng._tick_log
+        kinds = {w[0] for t in eng._tick_log for w in t["work"]}
+        assert "chunk" in kinds
+
+    def test_sampled_chunked_deterministic(self, tiny):
+        """A sampled request admitted through the chunk path stays
+        deterministic per seed and leaves greedy neighbors exact."""
+        cfg, params = tiny
+        p_g = [(i * 7 + 1) % cfg.vocab_size for i in range(5)]
+        p_s = [(i * 3 + 2) % cfg.vocab_size for i in range(13)]
+
+        def run(seed):
+            eng = self._eng(params, cfg, sampling=True, top_k=8,
+                            seed=seed, chunked_prefill=True,
+                            prefill_chunk=8)
+            rg = eng.submit(p_g, 6)
+            rs = eng.submit(p_s, 6, temperature=1.0)
+            done = {r.rid: r.tokens for r in eng.drain()}
+            return done[rg], done[rs]
+
+        g1, s1 = run(0)
+        g2, s2 = run(0)
+        assert g1 == g2 == solo(params, p_g, 6, cfg)
+        assert s1 == s2
+        assert all(0 <= t < cfg.vocab_size for t in s1)
+
+    def test_validation(self, tiny):
+        cfg, params = tiny
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(params, cfg, n_slots=1,
+                              prompt_buckets=(8,), prefix_cache=True)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            self._eng(params, cfg, chunked_prefill=True,
+                      prefill_chunk=12)   # not a page multiple
