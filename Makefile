@@ -22,6 +22,9 @@ bench:
 	$(PY) bench.py
 
 bench-smoke:                    # serving bench legs at tiny CPU configs
+	# 8 virtual devices so the sharded-serving leg (tp=1/2/4 + the
+	# equal-chip tp-vs-dp A/B) runs for real, not as skip rows
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py -q
 
 clean:

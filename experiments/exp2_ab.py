@@ -1,0 +1,136 @@
+"""Same-window A/B of the exp2-folded softmax (VERDICT item #4: the
+"exp-bound" bwd-kernel ceiling hypothesis).
+
+Two protocols in one run, both interleaved inside one window (the
+tunnel's cross-window variance measured 45% on sub-3ms kernels —
+dkv_ab.py's finding — so only interleaved bursts can rank a ~few-%
+transcendental change):
+
+1. RAW KERNELS: forward, and the fused dq+dkv backward, exp on vs
+   exp2-folded, alternating timing bursts, per-variant medians.
+2. TRAIN STEP bracket (step_ab protocol): SOFTMAX_EXP2 0 → 1 → 0 on
+   the flagship train step, reporting step ms + MFU per leg — the
+   A...A bracket bounds window drift, and the middle leg is the
+   hypothesis: if the bwd kernels are exp-bound, MFU moves; if the A/B
+   is flat, the committed record says the transcendental is NOT the
+   ceiling and the claim dies honestly.
+
+Usage: exp2_ab.py [--kernels-only | --step-only]
+"""
+
+import importlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax                                      # noqa: E402
+import jax.numpy as jnp                         # noqa: E402
+import numpy as np                              # noqa: E402
+
+fa = importlib.import_module("kubegpu_tpu.ops.flash_attention")
+RAW_BWD = fa.flash_attention_bwd.__wrapped__
+RAW_FWD = fa.flash_attention.__wrapped__
+
+B, HQ, HKV, T, D = 4, 16, 4, 2048, 128
+DT = jnp.bfloat16
+ITERS = 60
+ROUNDS = 5
+
+
+def fetch(x):
+    return float(np.asarray(jax.device_get(jnp.ravel(x)[0])))
+
+
+def kernel_ab():
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (B, HQ, T, D), DT)
+    k = jax.random.normal(kk, (B, HKV, T, D), DT)
+    v = jax.random.normal(kv, (B, HKV, T, D), DT)
+    g = jax.random.normal(kg, (B, HQ, T, D), DT)
+
+    variants = {}
+    for name, knob in (("exp", False), ("exp2", True)):
+        fa.SOFTMAX_EXP2 = knob
+
+        def mk():
+            def fwd_run(q_):
+                out, lse = RAW_FWD(q_, k, v, True, 512, 512, False,
+                                   True)
+                return (q_ + (out[0, 0, 0, 0]
+                              + lse[0, 0, 0]).astype(q_.dtype)
+                        * jnp.bfloat16(1e-8))
+
+            def bwd_run(g_):
+                out, lse = RAW_FWD(q, k, v, True, 512, 512, False,
+                                   True)
+                dq, dk, dv = RAW_BWD(q, k, v, out, lse, g_, True,
+                                     512, 512, False)
+                return (g_ + (dq[0, 0, 0, 0] + dk[0, 0, 0, 0]
+                              + dv[0, 0, 0, 0]).astype(g_.dtype)
+                        * jnp.bfloat16(1e-8))
+            return jax.jit(fwd_run), jax.jit(bwd_run)
+
+        try:
+            ffn, bfn = mk()
+            fetch(ffn(q))      # compile while the device queue is calm
+            fetch(bfn(g))
+            variants[name] = (ffn, bfn)
+            print(f"compiled {name}", flush=True)
+        except Exception as e:   # pragma: no cover - remote compile
+            print(f"{name}: COMPILE FAILED {str(e)[:120]}", flush=True)
+        finally:
+            fa.SOFTMAX_EXP2 = True
+
+    times = {n: {"fwd": [], "bwd": []} for n in variants}
+    for _ in range(ROUNDS):
+        for name, (ffn, bfn) in variants.items():
+            st = q
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                st = ffn(st)
+            fetch(st)
+            times[name]["fwd"].append((time.perf_counter() - t0) / ITERS)
+            st = g
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                st = bfn(st)
+            fetch(st)
+            times[name]["bwd"].append((time.perf_counter() - t0) / ITERS)
+    for name, tt in times.items():
+        for leg in ("fwd", "bwd"):
+            med = statistics.median(tt[leg])
+            print(f"{leg} {name}: median {med*1e3:7.3f} ms  "
+                  f"(all: {[round(x*1e3, 3) for x in tt[leg]]})",
+                  flush=True)
+    if {"exp", "exp2"} <= set(times):
+        for leg in ("fwd", "bwd"):
+            a = statistics.median(times["exp"][leg])
+            b = statistics.median(times["exp2"][leg])
+            print(f"{leg} exp/exp2 ratio: {a / b:.4f} "
+                  f"({'exp2 faster' if a > b else 'flat-or-slower'})",
+                  flush=True)
+
+
+def step_bracket():
+    from experiments.step_ab import one_leg
+    from kubegpu_tpu.benchmark import llama_bench_config
+    cfg = llama_bench_config()
+    for knob, value in (("SOFTMAX_EXP2", 0), ("SOFTMAX_EXP2", 1),
+                        ("SOFTMAX_EXP2", 0)):
+        one_leg(cfg, 4, 2048, knob, value)
+    fa.SOFTMAX_EXP2 = True
+
+
+def main():
+    args = set(sys.argv[1:])
+    if "--step-only" not in args:
+        kernel_ab()
+    if "--kernels-only" not in args:
+        step_bracket()
+
+
+if __name__ == "__main__":
+    main()

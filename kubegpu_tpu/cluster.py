@@ -52,8 +52,12 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
             multislice: bool = False,
             namespace: str = "default",
             migratable: bool = False,
-            hbm_gib: float = 0.0) -> Pod:
-    """Pod-spec builder — the user surface (reference: example/ YAML)."""
+            hbm_gib: float = 0.0,
+            workload: str | None = None) -> Pod:
+    """Pod-spec builder — the user surface (reference: example/ YAML).
+    ``workload="serving"`` annotates the traffic model: the scheduler
+    scores the gang's slice with serving axis weights (tp hot on every
+    decode step, dp-replica hops nearly free)."""
     pod = Pod(
         metadata=ObjectMeta(name=name, namespace=namespace),
         spec=PodSpec(containers=[ContainerSpec(
@@ -72,6 +76,9 @@ def tpu_pod(name: str, chips: int = 0, millitpu: int = 0,
         set_pod_multislice(pod)
     if migratable:
         set_pod_migratable(pod)
+    if workload is not None:
+        from kubegpu_tpu.kubemeta.codec import set_pod_workload_kind
+        set_pod_workload_kind(pod, workload)
     return pod
 
 

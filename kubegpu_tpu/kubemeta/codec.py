@@ -23,6 +23,11 @@ DEVICE_INFO_KEY = "node.alpha.kubetpu/device-information"
 ALLOCATE_FROM_KEY = "pod.alpha.kubetpu/allocate-from"
 GANG_KEY = "pod.alpha.kubetpu/gang"
 MESH_AXES_KEY = "pod.alpha.kubetpu/mesh-axes"
+# workload kind ("training" default | "serving"): serving gangs carry
+# a different traffic model — tp psums every decode step, dp replicas
+# never talk — so the scheduler scores their slices with serving axis
+# weights instead of the training defaults
+WORKLOAD_KIND_KEY = "pod.alpha.kubetpu/workload-kind"
 MULTISLICE_KEY = "pod.alpha.kubetpu/multislice"
 MIGRATABLE_KEY = "pod.alpha.kubetpu/migratable"
 # original queue position of an evicted+requeued pod: eviction (fault,
@@ -206,6 +211,19 @@ def pod_mesh_axes(pod: Pod) -> dict[str, int] | None:
     if not payload:
         return None
     return dict((k, int(v)) for k, v in json.loads(payload))
+
+
+def set_pod_workload_kind(pod: Pod, kind: str) -> None:
+    """Declare the workload kind driving the traffic model ("training"
+    is the implicit default; "serving" switches topology scoring to
+    serving axis weights — tp hot, dp-replica hops nearly free)."""
+    if kind not in ("training", "serving"):
+        raise ValueError(f"unknown workload kind {kind!r}")
+    pod.metadata.annotations[WORKLOAD_KIND_KEY] = kind
+
+
+def pod_workload_kind(pod: Pod) -> str:
+    return pod.metadata.annotations.get(WORKLOAD_KIND_KEY, "training")
 
 
 def set_pod_migratable(pod: Pod, allowed: bool = True) -> None:

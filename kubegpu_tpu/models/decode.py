@@ -116,13 +116,21 @@ def _cached_attend_q8(q: jax.Array, ck: jax.Array, cv: jax.Array,
     return out.reshape(b, hq, t, d).astype(q.dtype)
 
 
-def _dense_ffn(x: jax.Array, lp: dict, cfg: LlamaConfig) -> jax.Array:
+def _dense_ffn(x: jax.Array, lp: dict, cfg: LlamaConfig,
+               tp_axis: str | None = None) -> jax.Array:
     """The Llama SwiGLU FFN sublayer (residual included) — the default
     ``ffn`` of the cached forward; the MoE family swaps in its routed
-    experts here (models/moe.py serving section)."""
+    experts here (models/moe.py serving section).  Under a shard_map'd
+    tensor-parallel step (``tp_axis``) the gate/up weights are
+    column-sharded on d_ff and the down projection is row-sharded, so
+    the local product is a partial sum psum'd over the axis (the
+    megatron mlp allreduce)."""
     h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
     up = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-    return x + (up @ lp["w_down"]).astype(x.dtype)
+    down = up @ lp["w_down"]
+    if tp_axis is not None:
+        down = lax.psum(down, tp_axis)
+    return x + down.astype(x.dtype)
 
 
 def _project_qkv(h: jax.Array, lp: dict, cfg: LlamaConfig,
@@ -145,26 +153,42 @@ def _project_qkv(h: jax.Array, lp: dict, cfg: LlamaConfig,
 
 
 def _attn_finish(x: jax.Array, o: jax.Array, lp: dict,
-                 cfg: LlamaConfig, ffn) -> jax.Array:
+                 cfg: LlamaConfig, ffn,
+                 tp_axis: str | None = None) -> jax.Array:
     """Attention output [B, H, T, hd] → wo projection + residual +
-    feed-forward — the back half shared by the same three paths."""
+    feed-forward — the back half shared by the same three paths.
+    Under tensor parallelism (``tp_axis``, inside shard_map) ``o``
+    holds only this chip's heads and ``wo`` the matching rows, so the
+    projection is a partial sum psum'd over the axis (the megatron
+    attention allreduce); ``cfg`` is then the LOCAL per-chip config."""
     b, t = x.shape[0], x.shape[1]
     o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * cfg.head_dim)
-    x = x + (o @ lp["wo"]).astype(x.dtype)
+    proj = o @ lp["wo"]
+    if tp_axis is not None:
+        proj = lax.psum(proj, tp_axis)
+    x = x + proj.astype(x.dtype)
     return ffn(x, lp)
 
 
 def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
                         pos_offset: jax.Array, cfg: LlamaConfig,
-                        ffn=None) -> tuple[jax.Array, dict]:
+                        ffn=None, tp_axis: str | None = None
+                        ) -> tuple[jax.Array, dict]:
     """Run the decoder over ``tokens`` [B, T] starting at global position
     ``pos_offset`` (scalar), reading + writing the cache.  Returns
     (logits [B, T, vocab] f32, updated cache).  T=prompt for prefill,
     T=1 for decode — same code path, same executable shape per T.
-    ``ffn(x, lp) -> x`` overrides the feed-forward sublayer (MoE)."""
+    ``ffn(x, lp) -> x`` overrides the feed-forward sublayer (MoE).
+
+    ``tp_axis`` (inside a shard_map over that mesh axis): ``cfg`` is the
+    LOCAL config (n_heads/n_kv_heads/d_ff divided by the axis size),
+    the cache holds local KV heads, per-layer partial projections psum
+    over the axis, and the returned logits are the LOCAL vocab shard
+    [B, T, V/tp] — the caller all-gathers after position selection."""
     b, t = tokens.shape
     if ffn is None:
-        ffn = lambda x, lp: _dense_ffn(x, lp, cfg)   # noqa: E731
+        ffn = lambda x, lp: _dense_ffn(x, lp, cfg,   # noqa: E731
+                                       tp_axis=tp_axis)
     kv_int8 = "k_scale" in cache
     x = jnp.take(params["embed"], tokens, axis=0)
     q_pos = pos_offset + jnp.arange(t)
@@ -174,7 +198,7 @@ def _forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
         return _project_qkv(h, lp, cfg, positions)
 
     def finish(x, o, lp):
-        return _attn_finish(x, o, lp, cfg, ffn)
+        return _attn_finish(x, o, lp, cfg, ffn, tp_axis=tp_axis)
 
     if kv_int8:
         def layer(x, xs):

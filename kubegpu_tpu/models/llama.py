@@ -42,10 +42,15 @@ class LlamaConfig:
     # across layer boundaries at the cost of compile time (O(1) compile
     # was the reason for the scan; unroll trades some of it back)
     scan_unroll: int = 1
+    # Pin head_dim independently of d_model/n_heads.  The tensor-
+    # parallel serving engine derives a per-chip LOCAL config by
+    # dividing the head counts by tp; head_dim must stay the physical
+    # head width, not re-derive from the divided count.
+    head_dim_override: int | None = None
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":

@@ -45,6 +45,20 @@ r6 tentpole):
   slot for a whole forward.  Per-tick decode stall is tracked
   (``stall_ms``; ``serve_decode_stall_ms`` in a passed registry).
 
+The paged engine is MESH-NATIVE (the r7 tentpole): pass
+``mesh=make_serve_mesh(tp)`` and every executable runs under
+``shard_map`` on a ``("tp",)`` mesh — the page pool and both paged
+kernels shard over KV heads (per-chip pools hold Hkv/tp heads; the
+per-head attention math is embarrassingly parallel, so the kernels
+run unchanged on local shapes), weights split megatron-style with a
+per-layer psum and one lm_head all-gather per token pick, while page
+tables, refcounts, the prefix registry, and all per-slot host vectors
+stay REPLICATED — the admission/eviction/chunking logic above is
+sharding-oblivious and tokens are bit-identical to the unsharded
+engine.  dp scale-out is :class:`DataParallelServePool`: independent
+engine replicas behind one admission queue, no cross-replica
+collective ever.
+
 Correctness contract: slots are independent batch rows — a request's
 attention/FFN math never mixes with its neighbors'.  Tokens are
 bit-identical to a solo ``greedy_generate`` at the tested
@@ -65,7 +79,7 @@ from __future__ import annotations
 import functools
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 import jax
 import jax.numpy as jnp
@@ -173,19 +187,29 @@ def _paged_row_step(params: dict, tokens: jax.Array, pool: dict,
                     pt: jax.Array, tvec: jax.Array, tpad: jax.Array,
                     d0: jax.Array, buf: dict, pos: jax.Array,
                     j: jax.Array, cfg: LlamaConfig, interpret: bool,
-                    ffn=None) -> tuple[jax.Array, dict]:
+                    ffn=None, tp_axis: str | None = None
+                    ) -> tuple[jax.Array, dict]:
     """One decode step for every slot against the PAGED pool: flushed
     history via the pallas paged-attention kernel (reads only the pages
     each row actually holds), this block's keys via the write buffer,
     combined with the flash-decoding logsumexp merge.  Layers scan over
     (params, buffer, layer index); the pool rides as a loop-invariant
-    closure so nothing pool-sized is ever sliced or copied."""
+    closure so nothing pool-sized is ever sliced or copied.
+
+    ``tp_axis`` (inside a shard_map over that mesh axis): ``cfg`` is the
+    LOCAL config, the pool/buffer hold this chip's KV heads, the paged
+    kernel walks only the local head shard, per-layer partial
+    projections psum over the axis, and the lm_head's local vocab shard
+    all-gathers so the returned logits are FULL [B, V] on every chip
+    (token selection must be replicated — the picked token feeds the
+    next step's embedding on all chips)."""
     from kubegpu_tpu.ops.paged_attention import (
         merge_partials,
         paged_attention,
     )
     if ffn is None:
-        ffn = lambda x_, lp_: _dense_ffn(x_, lp_, cfg)   # noqa: E731
+        ffn = lambda x_, lp_: _dense_ffn(x_, lp_, cfg,   # noqa: E731
+                                         tp_axis=tp_axis)
     x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]   # [B,1,D]
     positions = pos[:, None]
     pool_k, pool_v = pool["k"], pool["v"]
@@ -206,14 +230,17 @@ def _paged_row_step(params: dict, tokens: jax.Array, pool: dict,
         o_b, m_b, l_b = _attend_buffer_partials(q, bk, bv, j)
         o = merge_partials(o_p, m_p, l_p, o_b, m_b, l_b)
         o = o[:, :, None, :].astype(x.dtype)            # [B,Hq,1,D]
-        return _attn_finish(x, o, lp, cfg, ffn), (bk, bv)
+        return _attn_finish(x, o, lp, cfg, ffn, tp_axis=tp_axis), \
+            (bk, bv)
 
     lidx = jnp.arange(cfg.n_layers, dtype=jnp.int32)
     x, (bk_new, bv_new) = lax.scan(
         layer, x, (params["layers"], buf["k"], buf["v"], lidx))
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits[:, 0], {"k": bk_new, "v": bv_new}
+    logits = (x @ params["lm_head"]).astype(jnp.float32)[:, 0]
+    if tp_axis is not None:
+        logits = lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
+    return logits, {"k": bk_new, "v": bv_new}
 
 
 def _flush_buffer_paged(pool: dict, buf: dict, pt: jax.Array,
@@ -420,12 +447,68 @@ def _pick_token(logits, temps, k_, top_k: int, sampling: bool):
     return jnp.where(temps > 0, sampled, greedy)
 
 
+def _serve_param_specs(quant_weights: bool):
+    """Per-leaf PartitionSpec tree for the tensor-parallel serving
+    engine (Llama decode weights; megatron column/row split over the
+    ``tp`` mesh axis).  The embedding is REPLICATED — decode looks it
+    up with ``take`` once per step, and a vocab-sharded table would
+    force the one-hot-matmul path for a [B] gather.  ``quant_weights``
+    mirrors the tree onto QTensor leaves: a per-output-channel scale
+    shards WITH its values on a column split and stays replicated on a
+    row split (its channel dim is the unsharded output)."""
+    from jax.sharding import PartitionSpec as P
+
+    def col(n_dims=3):
+        v = P(*([None] * (n_dims - 1) + ["tp"]))
+        if not quant_weights:
+            return v
+        from kubegpu_tpu.models.quant import QTensor
+        return QTensor(v, v)
+
+    def row():
+        v = P(None, "tp", None)
+        if not quant_weights:
+            return v
+        from kubegpu_tpu.models.quant import QTensor
+        return QTensor(v, P(None, None, None))
+
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": col(), "wk": col(), "wv": col(),
+            "wo": row(),
+            "mlp_norm": P(None, None),
+            "w_gate": col(), "w_up": col(),
+            "w_down": row(),
+        },
+        "final_norm": P(None),
+        "lm_head": col(2),
+    }
+
+
+def make_serve_mesh(tp: int, devices=None):
+    """A 1-axis ``("tp",)`` serving mesh over ``tp`` devices (defaults
+    to the first tp local devices).  dp scale-out does NOT live on this
+    mesh — dp replicas are fully independent engines behind one
+    admission queue (:class:`DataParallelServePool`), each pinned to
+    its own tp-submesh; there is no cross-replica collective to
+    express.  tp=1 over one device is valid and pins a replica."""
+    import numpy as _np
+    devs = list(devices if devices is not None else jax.devices()[:tp])
+    if len(devs) != tp:
+        raise ValueError(f"need {tp} devices for tp={tp}, got {len(devs)}")
+    from jax.sharding import Mesh
+    return Mesh(_np.array(devs), ("tp",))
+
+
 @functools.lru_cache(maxsize=32)
 def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                       page_size: int, stride: int, top_k: int = 0,
                       sampling: bool = False, interpret: bool = False,
                       kv_int8: bool = False, ffn_factory=None,
-                      ffn_cfg=None):
+                      ffn_cfg=None, mesh=None,
+                      quant_weights: bool = False):
     """Jitted engine pieces for the PAGED cache mode: the KV history
     lives in a page pool [L, n_pages, Hkv, P, D] shared by all slots
     (page 0 is a trash page, never allocated), addressed through a
@@ -433,15 +516,39 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
     Same write-buffer structure as the dense mode; the flushed history
     is read by the pallas paged-attention kernel, which only fetches
     the pages a row actually holds.  ``ffn_factory(ffn_cfg)`` swaps the
-    feed-forward sublayer (MoE serves through the pool this way)."""
-    ffn = ffn_factory(ffn_cfg) if ffn_factory is not None else None
+    feed-forward sublayer (MoE serves through the pool this way).
+
+    ``mesh`` (a ``("tp",)`` Mesh from :func:`make_serve_mesh`) makes
+    every executable MESH-NATIVE via ``jax.shard_map``: the pool and
+    both paged-attention kernel variants shard over KV heads (each
+    chip's pool holds Hkv/tp heads and its kernel walks only those),
+    weights split megatron-style (qkv/gate/up column-sharded, wo/down
+    row-sharded with a per-layer psum, lm_head vocab-sharded with an
+    all-gather before token selection), and page tables + every
+    per-slot host vector stay REPLICATED — admission, prefix caching,
+    LRU eviction, and chunked prefill are sharding-oblivious.
+    ``quant_weights`` keys the per-leaf spec tree for QTensor params
+    (it only matters when mesh is set)."""
+    if mesh is not None and ffn_factory is not None:
+        raise ValueError(
+            "tensor-parallel serving supports the dense Llama family "
+            "only (MoE scales out on dp replicas)")
+    tp = int(mesh.shape["tp"]) if mesh is not None else 1
+    tp_axis = "tp" if mesh is not None else None
+    lcfg = cfg if tp == 1 else replace(
+        cfg, n_heads=cfg.n_heads // tp, n_kv_heads=cfg.n_kv_heads // tp,
+        d_ff=cfg.d_ff // tp, head_dim_override=cfg.head_dim)
+    if ffn_factory is not None:
+        ffn = ffn_factory(ffn_cfg)
+    else:
+        ffn = lambda x_, lp_: _dense_ffn(x_, lp_, lcfg,   # noqa: E731
+                                         tp_axis=tp_axis)
 
     def _pick(logits, temps, k_):
         return _pick_token(logits, temps, k_, top_k, sampling)
 
-    @functools.partial(jax.jit, donate_argnames=("pool",))
-    def decode_block(params, pool, pt, tvec, tpad, tokens, pos, active,
-                     temps, base_key, tick):
+    def _block_body(params, pool, pt, tvec, tpad, tokens, pos, active,
+                    temps, base_key, tick):
         """``stride`` decode steps against the paged pool in ONE
         dispatch.  ``tvec``/``tpad``: per-row prompt length and
         (page-aligned) decode-region start; flushed decode count is
@@ -459,7 +566,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         # pool's (int8 pools quantize at flush, not at write — the
         # in-block keys are attended exactly)
         buf = {n: jnp.zeros((shape[0], n_slots, shape[2], stride,
-                             shape[4]), cfg.jdtype)
+                             shape[4]), lcfg.jdtype)
                for n in ("k", "v")}
 
         def step(carry, xs):
@@ -467,7 +574,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             j, k_ = xs
             logits, buf = _paged_row_step(
                 params, tokens, pool, pt, tvec, tpad, d0, buf, pos, j,
-                cfg, interpret, ffn=ffn)
+                lcfg, interpret, ffn=ffn, tp_axis=tp_axis)
             nxt = _pick(logits, temps, k_).astype(tokens.dtype)
             nxt = jnp.where(active, nxt, tokens)
             pos = jnp.where(active, pos + 1, pos)
@@ -478,27 +585,30 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         pool = _flush_buffer_paged(pool, buf, pt, tpad, d0, page_size)
         return block, tokens, pos, pool
 
-    @jax.jit
-    def prefill_wave(params, padded_prompts, true_lens, temps_w,
-                     base_key, rid0):
+    def _pw_body(params, padded_prompts, true_lens, temps_w,
+                 base_key, rid0):
         """Batch-k prefill producing a DENSE [L, k, Hkv, bucket, D]
         panel (bucket is a multiple of the page size) for page-wise
-        adoption.  First-token selection identical to the dense mode."""
+        adoption.  First-token selection identical to the dense mode.
+        Under tp the panel holds local heads and the lm_head's vocab
+        shard gathers AFTER last-position selection ([k, V/tp] rows,
+        not [k, bucket, V/tp] tensors, cross the axis)."""
         from kubegpu_tpu.models.decode import _forward_with_cache
         k = padded_prompts.shape[0]
         bucket = padded_prompts.shape[1]
-        cache_w = init_kv_cache(cfg, k, bucket)
+        cache_w = init_kv_cache(lcfg, k, bucket)
         logits, cache_w = _forward_with_cache(
-            params, padded_prompts, cache_w, jnp.int32(0), cfg, ffn=ffn)
+            params, padded_prompts, cache_w, jnp.int32(0), lcfg,
+            ffn=ffn, tp_axis=tp_axis)
         last = jnp.take_along_axis(
             logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+        if tp_axis is not None:
+            last = lax.all_gather(last, tp_axis, axis=-1, tiled=True)
         key = jax.random.fold_in(jax.random.fold_in(base_key, 1), rid0)
         return _pick(last, temps_w, key).astype(jnp.int32), cache_w
 
-    @functools.partial(jax.jit, static_argnames=("k",),
-                       donate_argnames=("pool",))
-    def adopt_wave(pool, cache_w, page_dst, slots, firsts, plens,
-                   temps_w, first_toks, tokens, pos, temps, k):
+    def _adopt_body(pool, cache_w, page_dst, slots, firsts, plens,
+                    temps_w, first_toks, tokens, pos, temps, k):
         """Admit a wave: copy each row's prompt panel page-by-page into
         its allocated pool pages (``page_dst`` [k, bucket/P] pool page
         ids) and update the per-slot device vectors.  k and the page
@@ -555,9 +665,8 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                 temps, temps_w[i:i + 1], (slots[i],))
         return pool, first_toks, tokens, pos, temps
 
-    @functools.partial(jax.jit, donate_argnames=("pool",))
-    def prefill_chunk(params, pool, chunk, pt_row, s, tlen, temps1,
-                      base_key, rid):
+    def _chunk_body(params, pool, chunk, pt_row, s, tlen, temps1,
+                    base_key, rid):
         """Process one page-aligned PROMPT CHUNK of a single slot
         directly against the pool: chunk tokens [1, C] at global
         positions [s, s+C), K/V written straight into the slot's pool
@@ -594,7 +703,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         )
         c = chunk.shape[1]
         c_pages = c // page_size
-        hd = cfg.head_dim
+        hd = lcfg.head_dim
         x = jnp.take(params["embed"], chunk, axis=0)          # [1, C, D]
         q_pos = s + jnp.arange(c)
         positions = jnp.broadcast_to(q_pos[None, :], (1, c))
@@ -607,8 +716,8 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                 lp, pk, pv, pks, pvs = xs
             else:
                 lp, pk, pv = xs      # per-layer [n_pages, Hkv, P, D]
-            h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-            q, k, v = _project_qkv(h, lp, cfg, positions)  # [1,H,C,D]
+            h = _rmsnorm(x, lp["attn_norm"], lcfg.norm_eps)
+            q, k, v = _project_qkv(h, lp, lcfg, positions)  # [1,H,C,D]
             if kv_int8:
                 kq, ksc = _quantize_rows(k)
                 vq, vsc = _quantize_rows(v)
@@ -632,7 +741,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                         pv, v[sl].astype(pv.dtype), (pid, 0, 0, 0))
             # chunk queries fold into the paged kernel's group dim
             # ((hkv, g, c)-major, matching _chunk_causal_partials)
-            qflat = q.reshape(1, cfg.n_heads * c, hd)
+            qflat = q.reshape(1, lcfg.n_heads * c, hd)
             o_p, m_p, l_p = paged_attention(
                 qflat, pk[None], pv[None], pt_row, jnp.int32(0),
                 svec, svec, zeros1,
@@ -643,11 +752,10 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             # same write-buffer-is-exact contract the decode block has
             o_c, m_c, l_c = _chunk_causal_partials(q, k, v)
             o = merge_partials(o_p, m_p, l_p, o_c, m_c, l_c)
-            o = o.reshape(1, cfg.n_heads, c, hd).astype(x.dtype)
+            o = o.reshape(1, lcfg.n_heads, c, hd).astype(x.dtype)
             new = (pk, pv, pks, pvs) if kv_int8 else (pk, pv)
-            return _attn_finish(x, o, lp, cfg,
-                                ffn or (lambda x_, lp_:
-                                        _dense_ffn(x_, lp_, cfg))), new
+            return _attn_finish(x, o, lp, lcfg, ffn,
+                                tp_axis=tp_axis), new
 
         if kv_int8:
             xs = (params["layers"], pool["k"], pool["v"],
@@ -668,6 +776,9 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         idx = jnp.clip(tlen - s - 1, 0, c - 1)                # [1]
         h_last = jnp.take_along_axis(x, idx[:, None, None], axis=1)
         logits = (h_last[:, 0] @ params["lm_head"]).astype(jnp.float32)
+        if tp_axis is not None:
+            logits = lax.all_gather(logits, tp_axis, axis=-1,
+                                    tiled=True)
         key = jax.random.fold_in(jax.random.fold_in(base_key, 1), rid)
         tok = _pick(logits, temps1, key).astype(jnp.int32)
         return tok, pool
@@ -676,12 +787,82 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
     def activate_slot(first_toks, tokens, pos, temps, slot, tok,
                       plen, temp):
         """Flip a chunk-prefilled slot live in ONE dispatch (the
-        chunk-path analog of adopt_wave's vector updates)."""
+        chunk-path analog of adopt_wave's vector updates).  Pure
+        replicated vector math — needs no shard_map even under tp
+        (every input is replicated; jit runs it SPMD on the mesh)."""
         first_toks = lax.dynamic_update_slice(first_toks, tok, (slot,))
         tokens = lax.dynamic_update_slice(tokens, tok, (slot,))
         pos = lax.dynamic_update_slice(pos, plen, (slot,))
         temps = lax.dynamic_update_slice(temps, temp, (slot,))
         return first_toks, tokens, pos, temps
+
+    if mesh is None:
+        decode_block = functools.partial(
+            jax.jit, donate_argnames=("pool",))(_block_body)
+        prefill_wave = jax.jit(_pw_body)
+        adopt_wave = functools.partial(
+            jax.jit, static_argnames=("k",),
+            donate_argnames=("pool",))(_adopt_body)
+        prefill_chunk = functools.partial(
+            jax.jit, donate_argnames=("pool",))(_chunk_body)
+        return decode_block, prefill_wave, adopt_wave, prefill_chunk, \
+            activate_slot
+
+    # -- mesh-native wrapping (shard_map over the tp axis) --------------
+    # replication checking off: pallas_call has no replication rule;
+    # every replicated output here is replicated by construction
+    # (identical math on identical operands, post-all-gather).
+    import functools as _ft
+
+    from jax.sharding import PartitionSpec as P
+
+    from kubegpu_tpu.parallel.sharding import compat_shard_map
+    shard_map = _ft.partial(compat_shard_map, check=False)
+    rep = P()
+    kvspec = P(None, None, "tp", None, None)
+    pool_spec = {"k": kvspec, "v": kvspec}
+    if kv_int8:
+        pool_spec.update(k_scale=P(None, None, "tp", None),
+                         v_scale=P(None, None, "tp", None))
+    cache_spec = {"k": kvspec, "v": kvspec}   # prefill panel: model dtype
+    pspec = _serve_param_specs(quant_weights)
+
+    _sm_block = shard_map(
+        _block_body, mesh=mesh,
+        in_specs=(pspec, pool_spec) + (rep,) * 9,
+        out_specs=(rep, rep, rep, pool_spec))
+
+    @functools.partial(jax.jit, donate_argnames=("pool",))
+    def decode_block(params, pool, pt, tvec, tpad, tokens, pos, active,
+                     temps, base_key, tick):
+        return _sm_block(params, pool, pt, tvec, tpad, tokens, pos,
+                         active, temps, base_key, tick)
+
+    prefill_wave = jax.jit(shard_map(
+        _pw_body, mesh=mesh, in_specs=(pspec,) + (rep,) * 5,
+        out_specs=(rep, cache_spec)))
+
+    @functools.partial(jax.jit, static_argnames=("k",),
+                       donate_argnames=("pool",))
+    def adopt_wave(pool, cache_w, page_dst, slots, firsts, plens,
+                   temps_w, first_toks, tokens, pos, temps, k):
+        fn = shard_map(
+            functools.partial(_adopt_body, k=k), mesh=mesh,
+            in_specs=(pool_spec, cache_spec) + (rep,) * 9,
+            out_specs=(pool_spec,) + (rep,) * 4)
+        return fn(pool, cache_w, page_dst, slots, firsts, plens,
+                  temps_w, first_toks, tokens, pos, temps)
+
+    _sm_chunk = shard_map(
+        _chunk_body, mesh=mesh,
+        in_specs=(pspec, pool_spec) + (rep,) * 7,
+        out_specs=(rep, pool_spec))
+
+    @functools.partial(jax.jit, donate_argnames=("pool",))
+    def prefill_chunk(params, pool, chunk, pt_row, s, tlen, temps1,
+                      base_key, rid):
+        return _sm_chunk(params, pool, chunk, pt_row, s, tlen, temps1,
+                         base_key, rid)
 
     return decode_block, prefill_wave, adopt_wave, prefill_chunk, \
         activate_slot
@@ -734,7 +915,7 @@ class ContinuousBatcher:
                  kv_int8: bool = False, prefix_cache: bool = False,
                  chunked_prefill: bool = False,
                  prefill_chunk: int | None = None,
-                 metrics=None):
+                 metrics=None, mesh=None):
         # model families: a MoEConfig serves through the same engine —
         # its Llama backbone drives attention/cache shapes, the routed
         # expert FFN rides the engine's ffn hook (VERDICT r4 weak #6:
@@ -752,6 +933,39 @@ class ContinuousBatcher:
             raise ValueError(
                 f"top_k {top_k} not in [0, vocab_size={cfg.vocab_size}]")
         self.sampling = sampling
+        # -- tensor-parallel serving (the mesh-native paged engine) ----
+        # ``mesh`` is a ("tp",) Mesh (make_serve_mesh); the page pool
+        # and both paged-attention kernels shard over KV heads, host
+        # state stays replicated.  Validated HERE so a bad degree fails
+        # at construction, not mid-trace.
+        self.mesh = mesh
+        self.tp = 1
+        if mesh is not None:
+            if not paged:
+                raise ValueError(
+                    "mesh (tensor-parallel) serving requires "
+                    "paged=True — the sharded engine is the page-pool "
+                    "engine; the dense slot cache has no mesh story")
+            if ffn_factory is not None:
+                raise ValueError(
+                    "tensor-parallel serving supports the dense Llama "
+                    "family only; MoE scales out on dp replicas "
+                    "(DataParallelServePool)")
+            if tuple(mesh.axis_names) != ("tp",):
+                raise ValueError(
+                    f"serving mesh must have exactly the ('tp',) axis, "
+                    f"got {mesh.axis_names} — dp replicas are separate "
+                    "engines (DataParallelServePool)")
+            self.tp = int(mesh.shape["tp"])
+            for name, val in (("n_kv_heads", cfg.n_kv_heads),
+                              ("n_heads", cfg.n_heads),
+                              ("d_ff", cfg.d_ff),
+                              ("vocab_size", cfg.vocab_size)):
+                if val % self.tp:
+                    raise ValueError(
+                        f"tp={self.tp} must divide cfg.{name}={val} "
+                        "(KV heads shard the pool; q heads/d_ff/vocab "
+                        "shard the weights)")
         # Wave-size cap, DEFAULT 8.  The r3 A/B was inconclusive
         # (tunnel weather swung 5x between windows); the r4 in-window
         # chained measurement settled it: at flagship shapes a k=8
@@ -803,10 +1017,18 @@ class ContinuousBatcher:
             self.total_pages = (total_pages if total_pages is not None
                                 else n_slots * self.max_pages)
             interpret = jax.devices()[0].platform == "cpu"
+            quant_weights = False
+            if mesh is not None:
+                from kubegpu_tpu.models.quant import QTensor
+                quant_weights = any(
+                    isinstance(leaf, QTensor) for leaf in jax.tree.leaves(
+                        params,
+                        is_leaf=lambda x: isinstance(x, QTensor)))
             self._fns = _paged_engine_fns(
                 cfg, n_slots, self.max_pages, page_size, stride, top_k,
                 sampling, interpret, kv_int8,
-                ffn_factory=ffn_factory, ffn_cfg=ffn_cfg)
+                ffn_factory=ffn_factory, ffn_cfg=ffn_cfg, mesh=mesh,
+                quant_weights=quant_weights)
             shape = (cfg.n_layers, self.total_pages + 1, cfg.n_kv_heads,
                      page_size, cfg.head_dim)
             if kv_int8:
@@ -821,6 +1043,26 @@ class ContinuousBatcher:
             else:
                 self.pool = {"k": jnp.zeros(shape, cfg.jdtype),
                              "v": jnp.zeros(shape, cfg.jdtype)}
+            if mesh is not None:
+                # shard ONCE at construction: the pool over KV heads,
+                # the weights megatron-style per _serve_param_specs.
+                # Every per-call executable then sees inputs already
+                # laid out per its in_specs — no per-tick resharding.
+                from jax.sharding import (
+                    NamedSharding,
+                    PartitionSpec as _P,
+                )
+                kv_s = NamedSharding(mesh, _P(None, None, "tp",
+                                              None, None))
+                sc_s = NamedSharding(mesh, _P(None, None, "tp", None))
+                pool_sh = {k: (sc_s if k.endswith("_scale") else kv_s)
+                           for k in self.pool}
+                self.pool = jax.device_put(self.pool, pool_sh)
+                param_sh = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    _serve_param_specs(quant_weights),
+                    is_leaf=lambda x: isinstance(x, _P))
+                self.params = jax.device_put(params, param_sh)
             self._free_pages = list(range(1, self.total_pages + 1))
             self._pt = np.zeros((n_slots, self.max_pages), np.int32)
             self._tvec = np.zeros((n_slots,), np.int32)
@@ -1438,3 +1680,99 @@ class ContinuousBatcher:
         a decode step, so it does not count here)."""
         return (self._decode_tokens / self.slot_steps
                 if self.slot_steps else 0.0)
+
+
+class DataParallelServePool:
+    """dp INDEPENDENT engine replicas behind ONE admission queue — the
+    scale-out half of mesh-native serving.  Each replica is a full
+    :class:`ContinuousBatcher` pinned to its own ``tp``-device submesh
+    (tp=1 pins a replica to a single chip); replicas share NOTHING on
+    device — no collective crosses replica boundaries, which is exactly
+    why serving dp splits across slices for free where training dp pays
+    a gradient allreduce (the scheduler's serving axis weights encode
+    the same fact).
+
+    ``submit()`` routes each request to the least-loaded replica
+    (queued + resident requests) at submit time — a static round-robin
+    would let one long request skew a whole replica's queue.  Prefix
+    caching is PER-REPLICA (pools don't alias across meshes), so
+    shared-prefix traffic benefits most when the router keeps it
+    together; the least-loaded policy is the throughput default."""
+
+    def __init__(self, params: dict, cfg, dp: int = 1, tp: int = 1,
+                 devices=None, **engine_kw):
+        devs = list(devices if devices is not None
+                    else jax.devices()[:dp * tp])
+        if len(devs) < dp * tp:
+            raise ValueError(
+                f"dp={dp} x tp={tp} needs {dp * tp} devices, "
+                f"have {len(devs)}")
+        engine_kw.setdefault("paged", True)
+        self.dp, self.tp = dp, tp
+        self.replicas = [
+            ContinuousBatcher(
+                params, cfg,
+                mesh=make_serve_mesh(tp, devs[i * tp:(i + 1) * tp]),
+                **engine_kw)
+            for i in range(dp)
+        ]
+        # rid namespacing: pool-level rid = replica * stride + local
+        self._rid_of: dict[tuple[int, int], int] = {}
+        self._next_rid = 0
+
+    def warmup(self) -> None:
+        for eng in self.replicas:
+            eng.warmup()
+
+    def _load(self, eng: ContinuousBatcher) -> int:
+        return len(eng.queue) + len(eng.slot_req)
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0) -> int:
+        i = min(range(self.dp), key=lambda j: self._load(self.replicas[j]))
+        local = self.replicas[i].submit(prompt, max_new_tokens,
+                                        temperature)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._rid_of[(i, local)] = rid
+        return rid
+
+    def step(self) -> list[_Request]:
+        done = []
+        for i, eng in enumerate(self.replicas):
+            for r in eng.step():
+                r.rid = self._rid_of.pop((i, r.rid))
+                done.append(r)
+        return done
+
+    def drain(self, max_ticks: int = 10_000) -> list[_Request]:
+        out: list[_Request] = []
+        for _ in range(max_ticks):
+            if not any(e.queue or e.slot_req for e in self.replicas):
+                return out
+            out.extend(self.step())
+        raise RuntimeError("drain did not converge")
+
+    @property
+    def emitted_tokens(self) -> int:
+        return sum(e.emitted_tokens for e in self.replicas)
+
+    @property
+    def occupancy(self) -> float:
+        steps = sum(e.slot_steps for e in self.replicas)
+        toks = sum(e._decode_tokens for e in self.replicas)
+        return toks / steps if steps else 0.0
+
+    # aggregate accounting mirrors the single-engine surface so the
+    # serve pod's metric echo works against either
+    @property
+    def prefill_waves(self) -> int:
+        return sum(e.prefill_waves for e in self.replicas)
+
+    @property
+    def slot_steps(self) -> int:
+        return sum(e.slot_steps for e in self.replicas)
+
+    @property
+    def stall_ms(self) -> list[float]:
+        return [s for e in self.replicas for s in e.stall_ms]

@@ -84,6 +84,21 @@ DKV_GROUPED_BQ_CAP = 512
 # kernel keeps whole [group·t, d] panels resident and needs the
 # headroom for the taller q-blocks the bench sweep favors.
 BWD_VMEM_LIMIT = 64 * 1024 * 1024
+# exp2-folded softmax (VERDICT r5 item #4: test the transcendental
+# hypothesis).  The TPU VPU's native transcendental is exp2; exp(x)
+# lowers to exp2(x·log2e) with a separate multiply per element.  With
+# the fold ON, scores are computed directly in the base-2 domain — the
+# log2(e) factor folds into the existing 1/sqrt(d) score scale (one
+# scalar at trace time, zero extra per-element work) and the
+# softmax/online-rescale transcendentals become exp2.  Mathematically
+# identical (exp(x) == exp2(x·log2e)); numerically within 1 ulp of the
+# exp formulation.  The emitted lse stays in NATURAL log (the
+# custom-vjp residual contract; the backward kernels re-fold it by
+# log2e at trace-in).  Module-level knob so experiments/exp2_ab.py and
+# step_ab.py can A/B it in one window (setattr + jax.clear_caches()).
+SOFTMAX_EXP2 = True
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
 
 
 _warned_fallback: set = set()
@@ -174,6 +189,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     vf = v.reshape(b * hkv, s, d)
     num_k_blocks = s // block_k
 
+    # exp2 fold (SOFTMAX_EXP2, trace-time): scores carry the log2e
+    # factor inside the score scale, so softmax transcendentals are
+    # native exp2 — same values, one fewer per-element multiply chain
+    # on the VPU than the exp lowering.
+    exp2_fold = bool(SOFTMAX_EXP2)
+    sscale = scale * LOG2E if exp2_fold else scale
+    _exp = jnp.exp2 if exp2_fold else jnp.exp
+
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None):
         qi = pl.program_id(2)
         # Dots run in the INPUT dtype with f32 accumulation
@@ -190,7 +213,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             vb = v_ref[0, pl.ds(ki * block_k, block_k), :]
             sc = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+                preferred_element_type=jnp.float32) * sscale  # [bq, bk]
             if causal:
                 qpos = causal_offset + qi * block_q + \
                     jax.lax.broadcasted_iota(
@@ -199,8 +222,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     jnp.int32, (block_q, block_k), 1)
                 sc = jnp.where(qpos >= kpos, sc, NEG_INF)
             m_new = jnp.maximum(m_acc, sc.max(axis=-1, keepdims=True))
-            p = jnp.exp(sc - m_new)
-            alpha = jnp.exp(m_acc - m_new)
+            p = _exp(sc - m_new)
+            alpha = _exp(m_acc - m_new)
             l_new = alpha * l_acc + p.sum(axis=-1, keepdims=True)
             o_new = alpha * o_acc + jax.lax.dot_general(
                 p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
@@ -225,8 +248,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         if lse_ref is not None:
             # lane-padded [block_q, LSE_LANES] tile (TPU blocks need the
             # last two dims (8k, 128m) or full; queries stay on sublanes
-            # so neither this write nor the backward's read transposes)
-            lse_ref[0] = jnp.broadcast_to(m_acc + jnp.log(l_safe),
+            # so neither this write nor the backward's read transposes).
+            # Under the exp2 fold m_acc is in base-2 units; one scalar
+            # multiply per row converts the emitted lse back to the
+            # natural-log residual contract.
+            m_nat = m_acc * LN2 if exp2_fold else m_acc
+            lse_ref[0] = jnp.broadcast_to(m_nat + jnp.log(l_safe),
                                           (block_q, LSE_LANES))
 
     # K/V index maps ignore (g, j): consecutive grid steps within one
@@ -344,6 +371,15 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
         jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                 axis=-1).reshape(b * h, t, 1), (b * h, t, LSE_LANES))
 
+    # exp2 fold (see the forward): scores carry log2e inside the score
+    # scale and p recovers via native exp2 against a pre-folded lse.
+    # ds keeps the NATURAL scale — d(sc_nat)/d(q·k) is scale, not
+    # scale·log2e; the fold only re-bases the softmax recompute.
+    exp2_fold = bool(SOFTMAX_EXP2)
+    sscale = scale * LOG2E if exp2_fold else scale
+    _exp = jnp.exp2 if exp2_fold else jnp.exp
+    lse_fold = LOG2E if exp2_fold else 1.0
+
     def dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
                   dq_ref):
         qi = pl.program_id(2)
@@ -352,7 +388,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
         # scores / ds instead of upcasting q)
         qb = q_ref[0]                                # [bq, d]
         dob = do_ref[0]                              # [bq, d]
-        lse_b = lse_ref[0][:, 0:1]                   # [bq, 1]
+        lse_b = lse_ref[0][:, 0:1] * lse_fold        # [bq, 1]
         delta_b = delta_ref[0][:, 0:1]               # [bq, 1]
 
         def body(ki, dq_acc):
@@ -360,7 +396,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
             vb = v_ref[0, pl.ds(ki * block_k, block_k), :]
             sc = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+                preferred_element_type=jnp.float32) * sscale  # [bq, bk]
             if causal:
                 qpos = causal_offset + qi * block_q + \
                     jax.lax.broadcasted_iota(
@@ -368,7 +404,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
                 kpos = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
                 sc = jnp.where(qpos >= kpos, sc, NEG_INF)
-            p = jnp.exp(sc - lse_b)                  # [bq, bk]
+            p = _exp(sc - lse_b)                     # [bq, bk]
             dp = jax.lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [bq, bk]
@@ -404,11 +440,11 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
                 rows = pl.ds(goff + qi * block_q_kv, block_q_kv)
                 qb = q_ref[0, rows, :]
                 dob = do_ref[0, rows, :]
-                lse_b = lse_ref[0, rows, 0:1]
+                lse_b = lse_ref[0, rows, 0:1] * lse_fold
                 delta_b = delta_ref[0, rows, 0:1]
                 sc = jax.lax.dot_general(
                     qb, kb, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32) * scale
+                    preferred_element_type=jnp.float32) * sscale
                 if causal:
                     qpos = causal_offset + qi * block_q_kv + \
                         jax.lax.broadcasted_iota(
@@ -416,7 +452,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
                     kpos = ki * block_k + jax.lax.broadcasted_iota(
                         jnp.int32, (block_q_kv, block_k), 1)
                     sc = jnp.where(qpos >= kpos, sc, NEG_INF)
-                p = jnp.exp(sc - lse_b)                  # [bq, bk]
+                p = _exp(sc - lse_b)                     # [bq, bk]
                 dv_new = dv_acc + jax.lax.dot_general(
                     p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)  # [bk, d]
@@ -450,7 +486,12 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal: bool = True,
         cparams = {}
     else:
         from jax.experimental.pallas import tpu as pltpu
-        cparams = {"compiler_params": pltpu.CompilerParams(
+        # spelled CompilerParams on the driver's jax, TPUCompilerParams
+        # on older images — same jax-generation split compat_shard_map
+        # papers over
+        cp_cls = getattr(pltpu, "CompilerParams", None) \
+            or pltpu.TPUCompilerParams
+        cparams = {"compiler_params": cp_cls(
             vmem_limit_bytes=BWD_VMEM_LIMIT)}
     qh_spec = pl.BlockSpec((1, block_q, d),
                            lambda i, g, j: (i * group + g, j, 0))

@@ -202,9 +202,10 @@ def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, n_microbatches: int):
             loss = lax.pmean(loss, "dp")
         return loss
 
-    return jax.shard_map(
-        local_loss, mesh=mesh, in_specs=(pspecs, tok_spec),
-        out_specs=P(), check_vma=False)
+    from kubegpu_tpu.parallel.sharding import compat_shard_map
+    return compat_shard_map(
+        local_loss, mesh, in_specs=(pspecs, tok_spec),
+        out_specs=P(), check=False)
 
 
 def make_pp_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,

@@ -92,5 +92,6 @@ def make_sharded_ring_attention(mesh, axis_name: str = "sp",
     spec = fit_spec(mesh, P(("dp", "fsdp"), "tp", axis_name, None))
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)
+    from kubegpu_tpu.parallel.sharding import compat_shard_map
+    return compat_shard_map(fn, mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check=False)

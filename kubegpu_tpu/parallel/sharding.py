@@ -37,3 +37,23 @@ def constrain(x: jax.Array, mesh: Mesh | None, *spec) -> jax.Array:
         return x
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, fit_spec(mesh, P(*spec))))
+
+
+def compat_shard_map(f, mesh: Mesh, in_specs, out_specs, check=False):
+    """shard_map across the jax API generations this repo meets: the
+    driver's image has ``jax.shard_map`` (replication checking spelled
+    ``check_vma``), older images only ``jax.experimental.shard_map``
+    (spelled ``check_rep``).  ``check=False`` is required wherever a
+    pallas_call runs inside the mapped body — pallas has no
+    replication rule on either generation."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check)
+        except TypeError:   # jax.shard_map without the vma keyword
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check)

@@ -53,6 +53,7 @@ from kubegpu_tpu.kubemeta.codec import (
     migration_debt_to_annotation,
     allocation_to_annotation,
     node_advertisement,
+    pod_workload_kind,
 )
 from kubegpu_tpu.kubemeta.objects import GangSpec
 from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace, get_logger
@@ -777,14 +778,16 @@ class DeviceScheduler:
         member = next(iter(pg.pods.values()))
         chips = member.spec.total_chips
         try:
+            axes = self._sane_axes(pod_mesh_axes(member),
+                                   pg.spec.size * chips)
             return GangRequest(
                 gang_name=pg.spec.name,
                 num_pods=pg.spec.size,
                 chips_per_pod=chips,
                 millitpu_per_pod=member.spec.total_millitpu,
                 hbm_gib_per_chip=member.spec.max_hbm_gib,
-                mesh_axes=self._sane_axes(pod_mesh_axes(member),
-                                          pg.spec.size * chips))
+                mesh_axes=axes,
+                axis_weights=self._serving_weights(member, axes))
         except ValueError:
             return None
 
@@ -1292,6 +1295,7 @@ class DeviceScheduler:
         members = self.gang_member_pods(gang)
         axes = pod_mesh_axes(members[0]) if members else None
         try:
+            sane = self._sane_axes(axes, len(asg.pods) * chips_per_pod)
             return GangRequest(
                 gang_name=gang, num_pods=len(asg.pods),
                 chips_per_pod=chips_per_pod,
@@ -1300,8 +1304,9 @@ class DeviceScheduler:
                 # real re-schedule then rejects (stranding the mover)
                 hbm_gib_per_chip=max(
                     (p.spec.max_hbm_gib for p in members), default=0.0),
-                mesh_axes=self._sane_axes(
-                    axes, len(asg.pods) * chips_per_pod),
+                mesh_axes=sane,
+                axis_weights=(self._serving_weights(members[0], sane)
+                              if members else None),
                 allow_multislice=bool(members)
                 and pod_multislice(members[0]))
         except ValueError:
@@ -1438,15 +1443,30 @@ class DeviceScheduler:
             prod *= v
         return axes if prod == total_chips else None
 
+    @staticmethod
+    def _serving_weights(pod: Pod, axes: dict[str, int] | None
+                         ) -> dict[str, float] | None:
+        """Serving gangs score their slices with the SERVING traffic
+        model (topology sees serving slices as what they are): tp
+        psums ride every decode step while dp replicas never exchange
+        a byte — so the allocator should spend its contiguous ICI on
+        the tp rings and may scatter replicas freely."""
+        if axes is None or pod_workload_kind(pod) != "serving":
+            return None
+        from kubegpu_tpu.topology.locality import serving_axis_weights
+        return serving_axis_weights(axes)
+
     def _request_for_single(self, pod: Pod) -> GangRequest:
         chips = pod.spec.total_chips
+        axes = self._sane_axes(pod_mesh_axes(pod), chips)
         return GangRequest(
             gang_name=pod.name,
             num_pods=1,
             chips_per_pod=chips,
             millitpu_per_pod=pod.spec.total_millitpu,
             hbm_gib_per_chip=pod.spec.max_hbm_gib,
-            mesh_axes=self._sane_axes(pod_mesh_axes(pod), chips),
+            mesh_axes=axes,
+            axis_weights=self._serving_weights(pod, axes),
         )
 
     def _request_for_gang(self, gang_name: str,
@@ -1456,14 +1476,16 @@ class DeviceScheduler:
         if len(per_pod) != 1 or len(milli) != 1:
             raise ValueError(f"gang {gang_name}: heterogeneous asks")
         chips = per_pod.pop()
+        axes = self._sane_axes(pod_mesh_axes(members[0]),
+                               len(members) * chips)
         return GangRequest(
             gang_name=gang_name,
             num_pods=len(members),
             chips_per_pod=chips,
             millitpu_per_pod=milli.pop(),
             hbm_gib_per_chip=max(p.spec.max_hbm_gib for p in members),
-            mesh_axes=self._sane_axes(pod_mesh_axes(members[0]),
-                                      len(members) * chips),
+            mesh_axes=axes,
+            axis_weights=self._serving_weights(members[0], axes),
             allow_multislice=pod_multislice(members[0]),
         )
 

@@ -33,6 +33,27 @@ DEFAULT_AXIS_WEIGHTS = {
     "dp": 1.0,
 }
 
+# SERVING traffic is a different shape from training: a tensor-parallel
+# decode engine psums activations over tp on EVERY layer of EVERY
+# stride-amortized decode step (latency-critical — it sits on the
+# token feedback path), while serving "dp" is independent engine
+# replicas behind one admission queue — NO collective ever crosses a
+# replica boundary, so a dp hop over a dead link or even DCN costs
+# (almost) nothing.  Near-zero rather than zero: keeping replicas near
+# each other still helps prefix-cache-affinity routing and shared
+# model-load traffic, and a zero weight would make the locality score
+# 0/0-degenerate for dp-only serving gangs.
+SERVING_AXIS_WEIGHTS = {
+    "tp": 8.0,
+    "dp": 0.05,
+}
+
+
+def serving_axis_weights(axis_sizes: dict[str, int]) -> dict[str, float]:
+    """Axis weights for a SERVING gang (see SERVING_AXIS_WEIGHTS):
+    tp collectives dominate, replica axes are nearly free."""
+    return {k: SERVING_AXIS_WEIGHTS.get(k, 1.0) for k in axis_sizes}
+
 
 def resolve_axis_weights(
     axis_sizes: dict[str, int],

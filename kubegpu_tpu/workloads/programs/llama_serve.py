@@ -200,12 +200,41 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
         "SERVE_PREFIX_CACHE", "0") == "1"
     chunked = paged and os.environ.get(
         "SERVE_CHUNKED_PREFILL", "0") == "1"
-    eng = ContinuousBatcher(params, cfg, n_slots=n_slots,
-                            max_len=max_len, stride=stride,
-                            prompt_buckets=(prompt_t,),
-                            paged=paged, page_size=page_size,
-                            kv_int8=kv_int8, prefix_cache=prefix_cache,
-                            chunked_prefill=chunked)
+    # mesh-native serving (SERVE_TP / SERVE_DP): shard the paged engine
+    # over tp chips (per-chip pools hold Hkv/tp heads) and/or run dp
+    # independent replicas behind one admission queue.  Degrades to
+    # the single-chip engine — loudly under strict mode — when the
+    # allocation or the head geometry can't satisfy the ask.
+    tp = int(os.environ.get("SERVE_TP", "1"))
+    dp = int(os.environ.get("SERVE_DP", "1"))
+    if paged and (tp > 1 or dp > 1):
+        n_dev = jax.device_count()
+        bad = []
+        if tp * dp > n_dev:
+            bad.append(f"dp*tp={dp * tp} > {n_dev} devices")
+        if cfg.n_kv_heads % tp:
+            bad.append(f"tp={tp} !| n_kv_heads={cfg.n_kv_heads}")
+        if bad:
+            from kubegpu_tpu.ops.strict import fallback
+            fallback("llama_serve.tp",
+                     "; ".join(bad) + " — single-chip engine would "
+                     "serve instead of the mesh-sharded one")
+            tp = dp = 1
+    eng_kw = dict(n_slots=n_slots, max_len=max_len, stride=stride,
+                  prompt_buckets=(prompt_t,), paged=paged,
+                  page_size=page_size, kv_int8=kv_int8,
+                  prefix_cache=prefix_cache, chunked_prefill=chunked)
+    if paged and dp > 1:
+        from kubegpu_tpu.models.serve import DataParallelServePool
+        eng = DataParallelServePool(params, cfg, dp=dp, tp=tp,
+                                    **eng_kw)
+    elif paged and tp > 1:
+        from kubegpu_tpu.models.serve import make_serve_mesh
+        eng = ContinuousBatcher(params, cfg,
+                                mesh=make_serve_mesh(tp), **eng_kw)
+    else:
+        tp = dp = 1
+        eng = ContinuousBatcher(params, cfg, **eng_kw)
     # compile every wave size + the decode block OUTSIDE the timed
     # window; warmup() is state-free, so the occupancy gauge stays
     # pure steady state
@@ -249,6 +278,11 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                 ("serve_engine_cfg_stride", stride),
                 ("serve_engine_cfg_requests", n_reqs),
                 ("serve_engine_cfg_paged", int(paged)),
+                # mesh config echo: the scheduler's topology score and
+                # the harvested tok/s must describe the same slice
+                ("serve_engine_cfg_tp", tp),
+                ("serve_engine_cfg_dp", dp),
+                ("serve_engine_cfg_mesh_devices", tp * dp),
                 ("serve_engine_cfg_kv_int8", int(kv_int8)),
                 ("serve_engine_cfg_int8_weights", int(int8)),
                 ("serve_engine_cfg_prefix_cache", int(prefix_cache)),

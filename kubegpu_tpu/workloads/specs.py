@@ -109,7 +109,27 @@ def llama_serving(slice_type: str = "v4-8") -> tuple[list[Pod], list[str]]:
     """Serving as a schedulable workload: a 1-chip pod runs KV-cache
     decode and reports its tokens/s as a harvestable metric line."""
     pods = [tpu_pod("llama-serve", chips=1, command=_prog("llama_serve"),
-                    env={"SERVE_STEPS": "16"})]
+                    env={"SERVE_STEPS": "16"},
+                    workload="serving")]
+    return pods, [slice_type]
+
+
+def tp_serving(tp: int = 4, dp: int = 1,
+               slice_type: str = "v5e-16") -> tuple[list[Pod], list[str]]:
+    """MULTI-CHIP serving: one pod asks for a dp x tp chip block and
+    runs the mesh-sharded continuous-batching engine (page pool split
+    over KV heads across the tp ring, dp independent replicas behind
+    one queue).  The gang request carries the tp degree in its mesh
+    axes AND the serving workload kind, so topology scoring sees a
+    serving slice: contiguous ICI goes to the tp ring, replica
+    adjacency is nearly free."""
+    pods = [tpu_pod(
+        "tp-serve", chips=dp * tp,
+        mesh_axes={"dp": dp, "tp": tp},
+        workload="serving",
+        command=_prog("llama_serve"),
+        env={"SERVE_MODE": "continuous", "SERVE_TP": str(tp),
+             "SERVE_DP": str(dp), "SERVE_STEPS": "16"})]
     return pods, [slice_type]
 
 
@@ -122,4 +142,5 @@ ALL_CONFIGS = {
     "allreduce": allreduce_gang,
     "t5": t5_seq2seq,
     "serve": llama_serving,
+    "tp_serve": tp_serving,
 }

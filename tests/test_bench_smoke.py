@@ -38,3 +38,27 @@ def test_serving_bench_smoke_parses_and_carries_keys():
         assert eh[leg]["e2e_tokens_per_s_anchored"] > 0
         assert eh[leg]["tokens"] > 0
     assert eh["paged_vs_dense_equal_hbm"] > 0
+
+    # sharded-serving leg (ISSUE 2): tp=1/2/4 scaling rows with
+    # per-phase timings + the equal-chip tp-vs-dp A/B.  Under the
+    # 8-virtual-device CPU window (conftest / make bench-smoke) every
+    # row must be populated, not skipped.
+    import jax
+    ts = doc["cb_tp_scaling"]
+    degrees = [1, 2, 4] if len(jax.devices()) >= 4 else [1]
+    for d in degrees:
+        row = ts["scaling"][f"tp{d}"]
+        assert "skipped" not in row, row
+        assert row["engine_tokens_per_s_anchored"] > 0
+        assert row["phase_decode_block_ms"] > 0
+        assert row["phase_admission_ms_by_wave"]
+        assert row["tokens"] == ts["requests"] * ts["new_tokens"]
+    if len(jax.devices()) >= 4:
+        ab = ts["equal_chip_ab"]
+        assert "skipped" not in ab, ab
+        assert ab["tp"]["engine_tokens_per_s_anchored"] > 0
+        assert ab["dp"]["engine_tokens_per_s_anchored"] > 0
+        assert ab["tp_vs_dp"] > 0
+        assert ab["winner"] in ("tp", "dp")
+        # same stream, both legs must finish every token
+        assert ab["tp"]["tokens"] == ab["dp"]["tokens"]

@@ -330,6 +330,51 @@ class TestFlashBackward:
                                        atol=5e-4, rtol=5e-4)
 
 
+class TestExp2Softmax:
+    """exp2-folded softmax (VERDICT item #4): exp(x) == exp2(x·log2e)
+    with the log2e folded into the score scale.  Both knob settings
+    must match the XLA reference — forward, lse (which stays NATURAL
+    log across the custom-vjp boundary regardless of the knob), and
+    all three gradients — so the A/B experiment compares two correct
+    kernels, not a fast-wrong one."""
+
+    @pytest.mark.parametrize("knob", [False, True])
+    def test_fwd_lse_bwd_match_reference(self, knob, monkeypatch):
+        import importlib
+        fa = importlib.import_module("kubegpu_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa, "SOFTMAX_EXP2", knob)
+        # module constants are trace-time: drop cached traces from the
+        # other knob setting
+        jax.clear_caches()
+        try:
+            q, k, v = rand_qkv(jax.random.PRNGKey(11), hq=4, hkv=2,
+                               t=64, s=64, d=32)
+            out, lse = fa.flash_attention(
+                q, k, v, causal=True, block_q=32, block_k=32,
+                interpret=True, return_lse=True)
+            ref = xla_attention(q, k, v, causal=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=1e-5, rtol=1e-5)
+            lse_ref = fa._xla_lse(q, k, True, q.shape[-1] ** -0.5)
+            np.testing.assert_allclose(np.asarray(lse),
+                                       np.asarray(lse_ref),
+                                       atol=1e-5, rtol=1e-5)
+            g = jnp.ones_like(out) / out.size
+            dq, dk, dv = fa.flash_attention_bwd(
+                q, k, v, out, lse, g, causal=True, block_q=32,
+                block_k=32, interpret=True)
+            _, vjp = jax.vjp(
+                lambda a, b, c: xla_attention(a, b, c, causal=True),
+                q, k, v)
+            for got, want, name in zip((dq, dk, dv), vjp(g),
+                                       ("dq", "dk", "dv")):
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=5e-5,
+                    rtol=5e-4, err_msg=f"{name} knob={knob}")
+        finally:
+            jax.clear_caches()   # don't leak knob'd traces to others
+
+
 class TestStrictMode:
     """KUBETPU_REQUIRE_PALLAS fences the silent-fallback class that
     poisoned r1-r3 MFU attribution (VERDICT r4 next-item #3): a hot

@@ -418,3 +418,61 @@ class TestLatencyAccounting:
         assert snap["histograms"]["schedule_latency_ms"]["count"] == 1
         assert snap["counters"]["gangs_failed"] == 1.0
         cl.close()
+
+
+class TestServingTrafficModel:
+    """Serving gangs carry the tp degree AND the serving workload kind,
+    so topology scoring sees a serving slice: tp rings stay the hot
+    axis while dp-replica hops are nearly free (no collective ever
+    crosses replica boundaries)."""
+
+    def test_serving_gang_request_carries_serving_weights(self):
+        cl = SimCluster(["v5e-16"])
+        pods = [
+            tpu_pod(f"s{i}", chips=4,
+                    gang=GangSpec(name="tp-serve", size=2, index=i),
+                    mesh_axes={"dp": 2, "tp": 4},
+                    workload="serving", command=["x"])
+            for i in range(2)
+        ]
+        req = cl.scheduler._request_for_gang("tp-serve", pods)
+        assert req.mesh_axes == {"dp": 2, "tp": 4}
+        assert req.axis_weights == {"dp": 0.05, "tp": 8.0}
+        cl.close()
+
+    def test_training_gang_keeps_default_weights(self):
+        cl = SimCluster(["v5e-16"])
+        pods = [
+            tpu_pod(f"t{i}", chips=4,
+                    gang=GangSpec(name="train", size=2, index=i),
+                    mesh_axes={"dp": 2, "tp": 4}, command=["x"])
+            for i in range(2)
+        ]
+        req = cl.scheduler._request_for_gang("train", pods)
+        assert req.axis_weights is None   # resolver falls back to
+        #                                   the training defaults
+        cl.close()
+
+    def test_tp_serving_single_pod_schedules(self):
+        """The tp_serving workload spec (one pod, dp x tp chips)
+        places end-to-end and its allocation covers the whole ask."""
+        from kubegpu_tpu.workloads.specs import tp_serving
+        pods, slice_types = tp_serving(tp=4, dp=1)
+        cl = SimCluster(slice_types)
+        for p in pods:
+            p.spec.containers[0].command = ["x"]   # don't exec
+            cl.submit(p)
+        cl.step()
+        alloc = pod_allocation(cl.api.get("Pod", "tp-serve"))
+        assert alloc is not None and len(alloc.chips) == 4
+        cl.close()
+
+    def test_serving_axis_weights_resolver(self):
+        from kubegpu_tpu.topology.locality import (
+            resolve_axis_weights,
+            serving_axis_weights,
+        )
+        w = serving_axis_weights({"dp": 2, "tp": 4})
+        assert w["tp"] > 100 * w["dp"]    # replicas are nearly free
+        # explicit weights still win over both default tables
+        assert resolve_axis_weights({"tp": 2}, w)["tp"] == w["tp"]

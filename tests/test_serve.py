@@ -505,3 +505,162 @@ class TestServingFastPath:
         with pytest.raises(ValueError, match="prefill_chunk"):
             self._eng(params, cfg, chunked_prefill=True,
                       prefill_chunk=12)   # not a page multiple
+
+
+class TestTensorParallelEngine:
+    """Mesh-native paged serving (ISSUE 2 tentpole): the pool and both
+    paged-attention kernels shard over KV heads via shard_map on a
+    ("tp",) mesh; page tables and admission state stay replicated.
+    Contract: EXACT token parity tp=1 vs tp=2/4 (and vs the solo dense
+    path), with prefix caching and chunked prefill active."""
+
+    @pytest.fixture(scope="class")
+    def tiny4(self):
+        # tp=4 needs tp | n_kv_heads
+        cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, max_seq_len=64)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def _eng(self, params, cfg, tp, **kw):
+        from kubegpu_tpu.models.serve import make_serve_mesh
+        kw.setdefault("n_slots", 3)
+        kw.setdefault("stride", 4)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("paged", True)
+        kw.setdefault("page_size", 8)
+        return ContinuousBatcher(params, cfg, mesh=make_serve_mesh(tp),
+                                 **kw)
+
+    def _run(self, eng, cfg, params):
+        """Staggered mixed traffic with shared-prefix followers and a
+        chunked long prompt; returns {rid: tokens}."""
+        shared = [(i * 5 + 3) % cfg.vocab_size for i in range(8)]
+        prompts = [(shared + [(41 + 9 * j + i) % cfg.vocab_size
+                              for i in range(5)], 6) for j in range(3)]
+        prompts += [([(i * 13 + 4) % cfg.vocab_size
+                      for i in range(15)], 5)]
+        rids, done = {}, {}
+        (p0, n0) = prompts[0]
+        rids[eng.submit(p0, n0)] = (p0, n0)
+        for _ in range(3):               # leader chunk-prefills + registers
+            done.update({r.rid: r.tokens for r in eng.step()})
+        for p, n in prompts[1:]:
+            rids[eng.submit(p, n)] = (p, n)
+        done.update({r.rid: r.tokens for r in eng.drain()})
+        return rids, done
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_exact_token_parity_tp1_vs_tpN(self, tiny4, tp):
+        """Bit-for-bit token parity tp=1 vs tp>1 with BOTH fast paths
+        active, and parity with solo greedy — the acceptance bar."""
+        cfg, params = tiny4
+        if len(jax.devices()) < tp:
+            pytest.skip(f"needs {tp} devices")
+        runs = {}
+        for deg in (1, tp):
+            eng = self._eng(params, cfg, deg, prefix_cache=True,
+                            chunked_prefill=True, prefill_chunk=8)
+            rids, done = self._run(eng, cfg, params)
+            runs[deg] = [done[rid] for rid in sorted(rids)]
+            assert eng.prefix_hits >= 1 and eng.chunks_run >= 1, \
+                "fast paths must actually engage under sharding"
+            for rid, (p, n) in rids.items():
+                assert done[rid] == solo(params, p, n, cfg), (deg, rid)
+        assert runs[1] == runs[tp]
+
+    def test_plain_paged_parity_tp2(self, tiny4):
+        """No fast paths: wave admission + adopt + decode blocks alone
+        keep exact parity under sharding."""
+        cfg, params = tiny4
+        eng = self._eng(params, cfg, 2)
+        prompts = [([(i * 3 + 1) % cfg.vocab_size for i in range(4)], 9),
+                   ([(i * 5 + 2) % cfg.vocab_size for i in range(11)], 7),
+                   ([(i * 7 + 5) % cfg.vocab_size for i in range(6)], 12)]
+        rids = {}
+        for p, n in prompts[:2]:
+            rids[eng.submit(p, n)] = (p, n)
+        eng.step()
+        for p, n in prompts[2:]:
+            rids[eng.submit(p, n)] = (p, n)
+        done = {r.rid: r for r in eng.drain()}
+        for rid, (p, n) in rids.items():
+            assert done[rid].tokens == solo(params, p, n, cfg), rid
+
+    def test_int8_pool_and_weights_tp2(self, tiny4):
+        """Quantized weights (QTensor leaves shard per-leaf: column
+        scales ride with their values, row scales stay replicated) +
+        int8 KV pages complete correctly under sharding."""
+        from kubegpu_tpu.models.quant import quantize_llama
+        cfg, params = tiny4
+        qparams = quantize_llama(params)
+        eng = self._eng(qparams, cfg, 2, kv_int8=True)
+        prompts = [([(i * 3 + 1) % cfg.vocab_size for i in range(4)], 9),
+                   ([(i * 5 + 2) % cfg.vocab_size for i in range(11)], 7)]
+        rids = {eng.submit(p, n): n for p, n in prompts}
+        done = {r.rid: r for r in eng.drain()}
+        assert set(done) == set(rids)
+        for rid, n in rids.items():
+            assert len(done[rid].tokens) == n
+            assert all(0 <= t < cfg.vocab_size
+                       for t in done[rid].tokens)
+
+    def test_sampled_deterministic_per_seed_tp2(self, tiny4):
+        cfg, params = tiny4
+        p_g = [(i * 7 + 1) % cfg.vocab_size for i in range(5)]
+        p_s = [(i * 3 + 2) % cfg.vocab_size for i in range(5)]
+
+        def run(seed):
+            eng = self._eng(params, cfg, 2, n_slots=2, sampling=True,
+                            top_k=8, seed=seed)
+            rg = eng.submit(p_g, 8)
+            rs = eng.submit(p_s, 8, temperature=1.0)
+            done = {r.rid: r.tokens for r in eng.drain()}
+            return done[rg], done[rs]
+
+        g1, s1 = run(0)
+        g2, s2 = run(0)
+        assert g1 == g2 == solo(params, p_g, 8, cfg)
+        assert s1 == s2
+        assert all(0 <= t < cfg.vocab_size for t in s1)
+
+    def test_dp_pool_exact_parity(self, tiny4):
+        """dp replicas behind one admission queue: every request exact
+        vs solo, across 2 replicas x tp=2."""
+        from kubegpu_tpu.models.serve import DataParallelServePool
+        cfg, params = tiny4
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices")
+        pool = DataParallelServePool(
+            params, cfg, dp=2, tp=2, n_slots=2, stride=4,
+            prompt_buckets=(8, 16), page_size=8)
+        prompts = [([(i * 3 + j) % cfg.vocab_size
+                     for i in range(4 + j)], 5 + j) for j in range(5)]
+        rids = {pool.submit(p, n): (p, n) for p, n in prompts}
+        done = {r.rid: r for r in pool.drain()}
+        assert set(done) == set(rids)
+        for rid, (p, n) in rids.items():
+            assert done[rid].tokens == solo(params, p, n, cfg), rid
+
+    def test_validation(self, tiny4):
+        from kubegpu_tpu.models.serve import make_serve_mesh
+        cfg, params = tiny4
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(params, cfg, n_slots=1,
+                              prompt_buckets=(8,), paged=False,
+                              mesh=make_serve_mesh(2))
+        # tp must divide the KV heads
+        cfg3 = LlamaConfig.tiny(n_heads=6, n_kv_heads=3,
+                                max_seq_len=64)
+        params3 = llama_init(jax.random.PRNGKey(1), cfg3)
+        with pytest.raises(ValueError, match="divide"):
+            ContinuousBatcher(params3, cfg3, n_slots=1,
+                              prompt_buckets=(8,), paged=True,
+                              page_size=8, mesh=make_serve_mesh(2))
+        # MoE rides dp replicas, not tp
+        from kubegpu_tpu.models.moe import MoEConfig, moe_init
+        mcfg = MoEConfig.tiny(max_seq_len=64)
+        mparams = moe_init(jax.random.PRNGKey(2), mcfg)
+        with pytest.raises(ValueError, match="Llama"):
+            ContinuousBatcher(mparams, mcfg, n_slots=1,
+                              prompt_buckets=(8,), paged=True,
+                              page_size=8, mesh=make_serve_mesh(2))
