@@ -11,6 +11,7 @@ from kubegpu_tpu.models.decode import (
     greedy_generate,
     init_kv_cache,
     sample_generate,
+    spec_acceptance,
     spec_generate,
     prefill,
 )
@@ -69,7 +70,7 @@ __all__ = [
     "ViTConfig", "vit_forward", "vit_init", "vit_param_specs",
     "init_kv_cache", "prefill", "decode_step", "greedy_generate",
     "sample_generate", "beam_generate", "beam_generate_paged",
-    "spec_generate", "draft_view",
+    "spec_generate", "draft_view", "spec_acceptance",
     "QTensor", "quantize_llama", "quantize_moe", "quantize_t5",
     "LoRAConfig", "lora_init", "lora_merge", "lora_param_specs",
     "make_lora_train_step",

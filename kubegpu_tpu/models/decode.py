@@ -812,7 +812,12 @@ def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
 def draft_view(params: dict, draft_layers: int) -> dict:
     """The first ``draft_layers`` of a stacked-layer tree as a model of
     their own (early-exit self-draft — no extra parameters): slice the
-    stacked leaves, share embed/final_norm/lm_head."""
+    stacked leaves, share embed/final_norm/lm_head.
+
+    Slicing copies the draft fraction of the weights, so loops must
+    call this ONCE and reuse the view: the serving engine caches it at
+    construction (``ContinuousBatcher._draft_params``) and the bench
+    rows build one view per window — never one per call."""
     return {
         "embed": params["embed"],
         # tree_map, not dict-comprehension slicing: leaves may be
@@ -823,6 +828,23 @@ def draft_view(params: dict, draft_layers: int) -> dict:
         "final_norm": params["final_norm"],
         "lm_head": params["lm_head"],
     }
+
+
+def spec_acceptance(drafted: jax.Array, full: jax.Array,
+                    cap) -> tuple[jax.Array, jax.Array]:
+    """THE speculative acceptance rule, shared by every spec path (the
+    host loop, the fused loop, and the serving engine's batched verify
+    tick): ``drafted`` [B, γ] proposals vs ``full`` [B, >=γ] full-model
+    argmaxes at the same positions.  Returns ``(matched, take)`` [B]
+    int32 — the longest matching prefix per element, and that prefix
+    capped by ``cap`` (a scalar for the lockstep loops' γ-1 draft-hole
+    cap, a [B] vector for the engine's per-slot adaptive γ).  A token
+    is only ever emitted if the FULL model argmaxed it, so any cap is
+    a throughput knob, never a correctness one."""
+    g = drafted.shape[1]
+    match = (drafted == full[:, :g]).astype(jnp.int32)
+    matched = jnp.cumprod(match, axis=1).sum(axis=1)
+    return matched, jnp.minimum(matched, cap)
 
 
 @functools.lru_cache(maxsize=32)
@@ -933,8 +955,7 @@ def spec_generate(params: dict, prompt: jax.Array, n_steps: int,
                                      jnp.int32(pos))
         f = jnp.argmax(vlogits, axis=-1)              # [B, g+1]
         drafted = jnp.stack(d_toks, axis=1)           # [B, g]
-        match = (drafted == f[:, :g]).astype(jnp.int32)
-        per_elem = jnp.cumprod(match, axis=1).sum(axis=1)   # [B]
+        per_elem, _ = spec_acceptance(drafted, f, g)  # cap applied below
         j = int(np.asarray(per_elem.min()))           # lockstep accept
         # cap at g-1: the g-th draft token was never PROCESSED by the
         # draft (only proposed), so accepting it would leave a hole in
@@ -1013,11 +1034,11 @@ def _spec_fused_fn(cfg: LlamaConfig, t: int, n_steps: int, max_len: int,
             vlogits, fcache = _forward_with_cache(params, chunk, fcache,
                                                   pos, cfg)
             f = jnp.argmax(vlogits, axis=-1).astype(cur.dtype)
-            match = (drafted == f[:, :gamma]).astype(jnp.int32)
             # lockstep accept: min over batch; cap γ-1 (the γ-th draft
             # was never processed by the draft model — it re-emerges as
             # the correction when all match) and the remaining budget
-            j = jnp.cumprod(match, axis=1).sum(axis=1).min()
+            matched, _ = spec_acceptance(drafted, f, gamma)
+            j = matched.min()
             take = jnp.minimum(jnp.minimum(j, gamma - 1),
                                n_steps - n_out - 1)
             corr = lax.dynamic_index_in_dim(f, take, axis=1,
@@ -1183,6 +1204,7 @@ def _paged_chunk_forward(params: dict, chunk: jax.Array, pool: dict,
     pages — rejected entries simply stay masked by the next
     iteration's smaller ``d``).  Returns (logits [B, C, V], pool')."""
     from kubegpu_tpu.ops.paged_attention import (
+        fold_chunk_queries,
         merge_partials,
         paged_attention,
     )
@@ -1220,7 +1242,7 @@ def _paged_chunk_forward(params: dict, chunk: jax.Array, pool: dict,
             return put(pk, k[r]), put(pv, v[r])
 
         pk, pv = lax.fori_loop(0, b, wrow, (pk, pv))
-        qflat = q.reshape(b, cfg.n_heads * c, hd)   # (hkv, g, c)-major
+        qflat = fold_chunk_queries(q)               # (hkv, g, c)-major
         o_p, m_p, l_p = paged_attention(
             qflat, pk[None], pv[None], pt, jnp.int32(0), zeros_b,
             zeros_b, d0, interpret=interpret)

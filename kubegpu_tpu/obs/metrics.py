@@ -3,6 +3,14 @@
 Feeds the driver-defined metrics (BASELINE.md): ``schedule_latency_ms``
 histogram (p50 is north-star #1), ``allocation_locality`` gauge per gang,
 plus scheduler throughput counters.  Thread-safe; structured-JSON export.
+
+Serving-engine histograms (observed by ``ContinuousBatcher`` when a
+registry is passed): ``serve_decode_stall_ms`` (per-tick admission work
+decode slots waited behind), ``serve_spec_accept`` (per-slot per-tick
+draft match fraction of the speculative engine), ``serve_spec_tokens_
+per_tick`` (tokens banked per slot per verify tick — accepted drafts +
+correction), and ``serve_collect_overlap_ms`` (host readout wall hidden
+behind the double-buffered next tick when ``collect_overlap`` is on).
 """
 
 from __future__ import annotations

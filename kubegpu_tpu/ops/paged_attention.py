@@ -128,6 +128,27 @@ def paged_attention_ref(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
     return (o.reshape(b, hq, dd), m.reshape(b, hq), l.reshape(b, hq))
 
 
+def fold_chunk_queries(q: jax.Array) -> jax.Array:
+    """The MULTI-QUERY-POSITION entry to the paged kernel, by
+    composition rather than a kernel variant: fold a query block
+    ``[B, Hq, C, D]`` (C positions per row) into the kernel's q-head
+    dim → ``[B, Hq·C, D]`` in (hkv, group, c)-major order.
+
+    Contract: all C positions of a row must share ONE history validity
+    window — true for a speculative-verify or prefill chunk, whose
+    queries all see the same flushed history ``[0, t) ∪ [t_pad,
+    t_pad+d)`` — because the kernel masks per ROW, not per query.  The
+    in-window causal part (query i attending chunk keys j <= i) is
+    computed separately by ``_chunk_causal_partials`` (decode.py),
+    which emits its partials in the SAME (hkv, group, c)-major order,
+    and the two merge positionally via :func:`merge_partials` — the
+    flash-decoding split applied to the chunk/history boundary.  Each
+    extra query rides as one more q head over the same K/V page walk,
+    so a γ+1-wide verify reads each history page exactly once."""
+    b, hq, c, d = q.shape
+    return q.reshape(b, hq * c, d)
+
+
 def merge_partials(o1: jax.Array, m1: jax.Array, l1: jax.Array,
                    o2: jax.Array, m2: jax.Array, l2: jax.Array
                    ) -> jax.Array:

@@ -39,6 +39,22 @@ def constrain(x: jax.Array, mesh: Mesh | None, *spec) -> jax.Array:
         x, NamedSharding(mesh, fit_spec(mesh, P(*spec))))
 
 
+def device_put_tree(mesh: Mesh, tree, spec_tree):
+    """``device_put`` a pytree against a matching PartitionSpec tree.
+
+    The serving engine lays out its big state ONCE at construction (the
+    page pool over KV heads, full and draft weights megatron-style per
+    ``_serve_param_specs``) so every per-tick executable sees inputs
+    already placed per its ``in_specs`` — no per-dispatch resharding.
+    QTensor-style container leaves work transparently: both ``tree``
+    and ``spec_tree`` carry them as pytree nodes, so values and scales
+    pick up their own specs in lockstep."""
+    sharding = jax.tree.map(
+        lambda s: NamedSharding(mesh, fit_spec(mesh, s)), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(tree, sharding)
+
+
 def compat_shard_map(f, mesh: Mesh, in_specs, out_specs, check=False):
     """shard_map across the jax API generations this repo meets: the
     driver's image has ``jax.shard_map`` (replication checking spelled

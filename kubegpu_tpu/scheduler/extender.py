@@ -150,6 +150,28 @@ class DeviceScheduler:
                         chips_per_pod=chips,
                         mesh_axes={"dp": pods} if pods > 1 else None))
 
+    def serving_metrics(self) -> dict:
+        """Serving-workload gauges the node agents harvested into this
+        scheduler's registry (``harvest_workload_metrics`` stores every
+        pod-printed metric line as ``workload_<name>``), keyed without
+        the prefix: engine config echo, throughput, decode-stall
+        percentiles — and, with the speculative serving engine, the
+        pod's draft ACCEPTANCE (``serve_engine_spec_accept_rate``) and
+        fused-tick token yield.  Acceptance is mirrored into the
+        ``serving_spec_acceptance`` gauge so the extender's scrape
+        surface (GET /metrics) carries it as a first-class scheduler
+        signal: a slice whose pods accept ~0 is paying draft compute
+        for nothing, which is a placement/config smell the operator
+        should see next to schedule latency, not buried in pod logs."""
+        with self._lock:
+            snap = self.metrics.snapshot()["gauges"]
+        out = {k[len("workload_"):]: v for k, v in snap.items()
+               if k.startswith("workload_serve_")}
+        acc = out.get("serve_engine_spec_accept_rate")
+        if acc is not None:
+            self.metrics.set_gauge("serving_spec_acceptance", acc)
+        return out
+
     # ------------------------------------------------------------------
     # Identity: in-memory gang/pod keys are NAMESPACE-QUALIFIED so two
     # tenants may both run a gang called "train" (or a pod "worker-0")

@@ -24,6 +24,14 @@ Env knobs:
   SERVE_INT8     "1" quantizes weights AND KV cache
                  (default: 0 tiny, 1 bench; continuous mode uses int8
                  weights only — its cache is bf16)
+  SERVE_SPEC_GAMMA  continuous+paged: engine-integrated speculative
+                 decoding — γ early-exit self-draft proposals per slot
+                 per tick, one full-model verify (0 = off, greedy
+                 only); SERVE_DRAFT_LAYERS picks the draft slice
+                 (default n_layers/4).  The pod echoes
+                 serve_engine_spec_accept_rate and
+                 serve_engine_spec_tokens_per_tick so the harvested
+                 tok/s carries the acceptance that produced it
 
 The decode throughput metric subtracts a separately-timed prefill of
 the same configuration (the advisor's r2 finding: dividing by an
@@ -200,6 +208,21 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
         "SERVE_PREFIX_CACHE", "0") == "1"
     chunked = paged and os.environ.get(
         "SERVE_CHUNKED_PREFILL", "0") == "1"
+    # engine-integrated speculative decoding (SERVE_SPEC_GAMMA > 0):
+    # batched greedy early-exit self-draft + one full-model verify per
+    # tick; SERVE_DRAFT_LAYERS picks the slice depth (default L/4).
+    # Paged-only — under strict mode a spec ask on a dense fallback
+    # aborts rather than silently serving the one-token path.
+    spec_gamma = int(os.environ.get("SERVE_SPEC_GAMMA", "0"))
+    dl_env = os.environ.get("SERVE_DRAFT_LAYERS")
+    draft_layers = int(dl_env) if dl_env else None
+    if spec_gamma and not paged:
+        from kubegpu_tpu.ops.strict import fallback
+        fallback("llama_serve.spec",
+                 f"SERVE_SPEC_GAMMA={spec_gamma} needs the paged "
+                 "engine; the dense fallback would serve the plain "
+                 "one-token-per-slot path")
+        spec_gamma = 0
     # mesh-native serving (SERVE_TP / SERVE_DP): shard the paged engine
     # over tp chips (per-chip pools hold Hkv/tp heads) and/or run dp
     # independent replicas behind one admission queue.  Degrades to
@@ -223,7 +246,8 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
     eng_kw = dict(n_slots=n_slots, max_len=max_len, stride=stride,
                   prompt_buckets=(prompt_t,), paged=paged,
                   page_size=page_size, kv_int8=kv_int8,
-                  prefix_cache=prefix_cache, chunked_prefill=chunked)
+                  prefix_cache=prefix_cache, chunked_prefill=chunked,
+                  spec_gamma=spec_gamma, draft_layers=draft_layers)
     if paged and dp > 1:
         from kubegpu_tpu.models.serve import DataParallelServePool
         eng = DataParallelServePool(params, cfg, dp=dp, tp=tp,
@@ -287,6 +311,18 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                 ("serve_engine_cfg_int8_weights", int(int8)),
                 ("serve_engine_cfg_prefix_cache", int(prefix_cache)),
                 ("serve_engine_cfg_chunked_prefill", int(chunked)),
+                # speculative-serving echo: the harvested tok/s and
+                # the acceptance that produced it travel together, so
+                # the scheduler/registry sees drafting quality per pod
+                ("serve_engine_cfg_spec_gamma", spec_gamma),
+                ("serve_engine_cfg_draft_layers",
+                 getattr(eng, "draft_layers",
+                         eng.replicas[0].draft_layers
+                         if hasattr(eng, "replicas") else 0)),
+                ("serve_engine_spec_accept_rate",
+                 round(eng.spec_acceptance_rate, 4)),
+                ("serve_engine_spec_tokens_per_tick",
+                 round(eng.spec_tokens_per_tick, 3)),
                 ("serve_engine_phase_warmup_ms",
                  round(warmup_s * 1e3, 1)),
                 ("serve_engine_phase_drain_ms",

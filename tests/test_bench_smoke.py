@@ -62,3 +62,23 @@ def test_serving_bench_smoke_parses_and_carries_keys():
         assert ab["winner"] in ("tp", "dp")
         # same stream, both legs must finish every token
         assert ab["tp"]["tokens"] == ab["dp"]["tokens"]
+
+    # engine-integrated speculation (ISSUE 3): spec-on vs spec-off on
+    # one request window, trained draft, at tp=1 and tp=2.  Greedy
+    # acceptance is deterministic on the fixed-seed trained model, so
+    # the smoke asserts the STRUCTURAL wins (bit parity, >= 0.5
+    # acceptance, fewer dispatches for the same tokens), not timings.
+    sp = doc["cb_spec"]
+    assert sp["draft_layers"] == 2 and sp["gammas"] == [3]
+    degrees = ["tp1", "tp2"] if len(jax.devices()) >= 2 else ["tp1"]
+    for name in degrees:
+        row = sp["by_tp"][name]
+        assert "skipped" not in row, row
+        assert row["off"]["engine_tokens_per_s_anchored"] > 0
+        g = row["gamma3"]
+        assert g["parity_vs_off"] is True and row["parity_all"] is True
+        assert g["acceptance_rate"] >= 0.5        # trained-model draft
+        assert g["tokens_per_tick"] > 1.5         # host sync amortized
+        assert g["verify_ticks"] < row["off"]["ticks"]
+        assert g["engine_tokens_per_s_anchored"] > 0
+        assert row["best_gamma"] == 3

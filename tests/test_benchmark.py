@@ -40,7 +40,7 @@ class TestModelBench:
                             "continuous_batching",
                             "continuous_batching_flagship",
                             "cb_prefix_cache", "cb_chunked_stall",
-                            "cb_equal_hbm"}
+                            "cb_equal_hbm", "cb_spec"}
         curve = fam["spec_decode_pld_curve"]
         assert len(curve) >= 3
         for p in curve:
@@ -88,6 +88,14 @@ class TestModelBench:
         assert fam["cb_prefix_cache"]["prefill_reduction_x"] > 1.0
         assert fam["cb_chunked_stall"]["on"]["chunk_cost_ms"] > 0
         assert fam["cb_equal_hbm"]["paged_vs_dense_equal_hbm"] > 0
+        # engine-integrated speculation rides the SAME trained model;
+        # its structural bars live in test_bench_smoke — here only the
+        # row's presence + parity (greedy bit-exact vs spec-off)
+        for row in fam["cb_spec"]["by_tp"].values():
+            if "skipped" in row:
+                continue
+            assert row["parity_all"] is True
+            assert row["off"]["engine_tokens_per_s_anchored"] > 0
 
     def test_flops_scale_with_tokens(self):
         cfg = benchmark.llama_bench_config()

@@ -195,6 +195,79 @@ class TestPagePoolFuzz:
         check_pool_invariants(eng)
 
 
+class TestSpeculativeRollback:
+    """Rollback invariants of the speculative verify tick (ISSUE 3):
+    rejected draft tokens roll back by VALIDITY — the per-row flushed
+    count simply doesn't advance over them and the next slab overwrites
+    in place — never by page surgery.  So across any rejection, page
+    ownership must be bit-stable and the plain-pool partition law must
+    hold at every tick."""
+
+    def _mk(self, cfg, params, **kw):
+        kw.setdefault("spec_gamma", 2)
+        kw.setdefault("draft_layers", 1)
+        return make_engine(cfg, params, **kw)
+
+    def test_spec_fuzz_churn_no_double_use_no_leak(self, tiny):
+        """The plain-pool fuzz, speculative edition: invariants after
+        every tick, exact completion counts, full free list at the
+        end (no page leaked or aliased by any rejected slab)."""
+        cfg, params = tiny
+        rng = np.random.default_rng(43)
+        eng = self._mk(cfg, params)
+        want, done = {}, {}
+        for _ in range(80):
+            if rng.random() < 0.5 and len(eng.queue) < 4:
+                plen = int(rng.integers(1, 16))
+                new = int(rng.integers(1, 7))
+                prompt = rng.integers(0, cfg.vocab_size, plen)
+                want[eng.submit(prompt, new)] = new
+            for r in eng.step():
+                done[r.rid] = len(r.tokens)
+            check_pool_invariants(eng)
+        for r in eng.drain():
+            done[r.rid] = len(r.tokens)
+        check_pool_invariants(eng)
+        assert not eng._slot_pages
+        assert len(eng._free_pages) == eng.total_pages
+        assert done == want
+
+    def test_rejection_never_touches_page_tables(self, tiny):
+        """An untrained draft gets rejected nearly every tick; across
+        all of a request's ticks its page-table row must stay EXACTLY
+        the admission-time row (rollback is positional, not table
+        mutation)."""
+        cfg, params = tiny
+        eng = self._mk(cfg, params)
+        rid = eng.submit(np.arange(1, 7), 8)
+        eng.step()                        # admit + first verify tick
+        assert 0 in eng._slot_pages
+        admitted_row = eng._pt[0].copy()
+        ticks, finished = 0, []
+        while eng.slot_req and ticks < 100:
+            if 0 in eng.slot_req:
+                assert (eng._pt[0] == admitted_row).all()
+            finished.extend(eng.step())
+            check_pool_invariants(eng)
+            ticks += 1
+        assert [r.rid for r in finished] == [rid]
+        assert (eng._pt[0] == 0).all()    # retired row zeroed
+
+    def test_spec_pages_cover_gamma_overhang(self, tiny):
+        """_pages_needed must budget the rejected-slab overhang: a
+        spec engine asks for at least the plain extent and the fuzz
+        above would catch any under-allocation as a trash-page alias;
+        here we pin the formula's γ slack directly."""
+        cfg, params = tiny
+        plain = make_engine(cfg, params)
+        spec = self._mk(cfg, params, spec_gamma=2)
+        need_p = plain._pages_needed(8, 8)
+        need_s = spec._pages_needed(8, 8)
+        assert need_s >= need_p
+        # γ slack: max_new + γ tokens of decode extent, page-rounded
+        assert need_s == 8 // 8 + -(-(8 + 2) // 8)
+
+
 class TestRefcountedPrefixPool:
     """Multi-owner refcount semantics (ISSUE 1 tentpole): aliasing,
     release order, last-owner frees, cached retention, LRU
@@ -332,6 +405,41 @@ class TestRefcountedPrefixPool:
         assert done == want
         assert eng.prefix_hits == 2
         # sharded retirement returns every non-cached page
+        assert len(eng._free_pages) + len(eng._page_refs) == \
+            eng.total_pages
+
+    def test_spec_churn_with_prefix_cache_no_leak(self, tiny):
+        """The refcount churn fuzz, SPECULATIVE edition: the verify
+        tick writes γ+1-wide slabs through the page tables and rolls
+        rejected tokens back by validity — the multi-owner partition
+        law must hold tick-for-tick anyway, and every request must
+        still finish exactly."""
+        cfg, params = tiny
+        rng = np.random.default_rng(11)
+        eng = self._mk(cfg, params, spec_gamma=2, draft_layers=1,
+                       chunked_prefill=True)
+        shared = [(i * 5 + 3) % cfg.vocab_size for i in range(8)]
+        want, done = {}, {}
+        for _ in range(60):
+            if rng.random() < 0.5 and len(eng.queue) < 4:
+                new = int(rng.integers(1, 6))
+                if rng.random() < 0.5:
+                    plen = int(rng.integers(9, 16))
+                    prompt = shared + list(
+                        rng.integers(0, cfg.vocab_size, plen - 8))
+                else:
+                    plen = int(rng.integers(1, 16))
+                    prompt = list(
+                        rng.integers(0, cfg.vocab_size, plen))
+                want[eng.submit(prompt, new)] = new
+            for r in eng.step():
+                done[r.rid] = len(r.tokens)
+            check_refcount_invariants(eng)
+        for r in eng.drain():
+            done[r.rid] = len(r.tokens)
+        check_refcount_invariants(eng)
+        assert done == want
+        assert not eng._slot_pages
         assert len(eng._free_pages) + len(eng._page_refs) == \
             eng.total_pages
 

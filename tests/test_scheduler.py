@@ -476,3 +476,29 @@ class TestServingTrafficModel:
         assert w["tp"] > 100 * w["dp"]    # replicas are nearly free
         # explicit weights still win over both default tables
         assert resolve_axis_weights({"tp": 2}, w)["tp"] == w["tp"]
+
+    def test_serving_metrics_surfaces_spec_acceptance(self):
+        """Harvested serving-pod metric lines (incl. the speculative
+        engine's acceptance echo) surface through the scheduler's
+        serving_metrics() view, and acceptance lands as the
+        serving_spec_acceptance gauge on the scrape surface."""
+        import json as _json
+
+        from kubegpu_tpu.crishim.agent import harvest_workload_metrics
+        cl = SimCluster(["v4-8"])
+        stdout = "\n".join(_json.dumps({"metric": m, "value": v}) for
+                           m, v in (
+            ("serve_engine_tokens_per_s", 1234.5),
+            ("serve_engine_cfg_spec_gamma", 4),
+            ("serve_engine_cfg_draft_layers", 8),
+            ("serve_engine_spec_accept_rate", 0.625),
+            ("serve_engine_spec_tokens_per_tick", 3.5),
+        ))
+        seen = harvest_workload_metrics(stdout, cl.metrics, "serve-0")
+        assert "serve_engine_spec_accept_rate" in seen
+        out = cl.scheduler.serving_metrics()
+        assert out["serve_engine_spec_accept_rate"] == 0.625
+        assert out["serve_engine_cfg_spec_gamma"] == 4
+        assert out["serve_engine_spec_tokens_per_tick"] == 3.5
+        assert cl.metrics.gauge("serving_spec_acceptance") == 0.625
+        cl.close()

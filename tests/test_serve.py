@@ -664,3 +664,182 @@ class TestTensorParallelEngine:
             ContinuousBatcher(mparams, mcfg, n_slots=1,
                               prompt_buckets=(8,), paged=True,
                               page_size=8, mesh=make_serve_mesh(2))
+
+
+class TestSpeculativeEngine:
+    """Batched speculative decoding inside the paged engine (ISSUE 3
+    tentpole): per tick a batched early-exit self-draft proposes γ
+    tokens per slot and ONE full-model verify forward scores all
+    [n_slots, γ+1] positions, with per-slot acceptance and validity-
+    based rollback.  Contract: every emitted token is the FULL model's
+    argmax by construction, so the spec engine must be token-for-token
+    identical to the spec-off engine AND to solo greedy — at tp=1 and
+    tp=2, with prefix caching and chunked prefill active."""
+
+    @pytest.fixture(scope="class")
+    def tiny4(self):
+        cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, max_seq_len=64)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def _eng(self, params, cfg, tp=1, **kw):
+        from kubegpu_tpu.models.serve import make_serve_mesh
+        kw.setdefault("n_slots", 3)
+        kw.setdefault("stride", 4)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("paged", True)
+        kw.setdefault("page_size", 8)
+        return ContinuousBatcher(
+            params, cfg, mesh=make_serve_mesh(tp) if tp > 1 else None,
+            **kw)
+
+    def _traffic(self, cfg):
+        shared = [(i * 5 + 3) % cfg.vocab_size for i in range(8)]
+        prompts = [(shared + [(41 + 9 * j + i) % cfg.vocab_size
+                              for i in range(5)], 6) for j in range(3)]
+        prompts += [([(i * 13 + 4) % cfg.vocab_size
+                      for i in range(15)], 5)]
+        return prompts
+
+    def _run(self, eng, prompts):
+        rids, done = {}, {}
+        (p0, n0) = prompts[0]
+        rids[eng.submit(p0, n0)] = (p0, n0)
+        for _ in range(3):
+            done.update({r.rid: r.tokens for r in eng.step()})
+        for p, n in prompts[1:]:
+            rids[eng.submit(p, n)] = (p, n)
+        done.update({r.rid: r.tokens for r in eng.drain()})
+        return rids, done
+
+    @pytest.mark.parametrize("tp", [1, 2])
+    def test_spec_bit_parity_with_fast_paths(self, tiny4, tp):
+        """The acceptance bar: greedy bit-exact tokens vs the
+        spec-off engine (and solo) with BOTH fast paths engaged, at
+        tp=1 and tp=2."""
+        cfg, params = tiny4
+        if len(jax.devices()) < tp:
+            pytest.skip(f"needs {tp} devices")
+        prompts = self._traffic(cfg)
+        runs = {}
+        for gamma in (0, 3):
+            eng = self._eng(params, cfg, tp, prefix_cache=True,
+                            chunked_prefill=True, prefill_chunk=8,
+                            spec_gamma=gamma,
+                            draft_layers=1 if gamma else None)
+            rids, done = self._run(eng, prompts)
+            runs[gamma] = [done[rid] for rid in sorted(rids)]
+            for rid, (p, n) in rids.items():
+                assert done[rid] == solo(params, p, n, cfg), (tp, rid)
+            if gamma:
+                assert eng.spec_ticks > 0
+                assert 0.0 <= eng.spec_acceptance_rate <= 1.0
+                assert eng.spec_tokens_per_tick >= 1.0
+                assert eng.prefix_hits >= 1 and eng.chunks_run >= 1, \
+                    "fast paths must actually engage under speculation"
+        assert runs[0] == runs[3]
+
+    def test_gamma_zero_is_plain_engine(self, tiny4):
+        """γ=0 degrades bit-exactly to today's path because it IS
+        today's path: no verify executable, no draft view, the
+        decode-block tick."""
+        cfg, params = tiny4
+        eng = self._eng(params, cfg)
+        assert eng.spec_gamma == 0
+        assert eng._fns[5] is None
+        assert eng._draft_params is None
+
+    def test_adaptive_gamma_monotone_and_bounded(self):
+        """The host-side γ-adaptation rule: monotone non-decreasing in
+        the acceptance EMA, clipped to [0, γ], full depth at EMA 1."""
+        import numpy as np
+
+        from kubegpu_tpu.models.serve import _gamma_from_accept
+        for gamma in (1, 2, 4, 8):
+            emas = np.linspace(0.0, 1.0, 101)
+            caps = _gamma_from_accept(emas, gamma)
+            assert (np.diff(caps) >= 0).all()          # monotone
+            assert caps.min() >= 0 and caps.max() <= gamma
+            assert caps[-1] == gamma                   # optimism at 1
+            assert caps[0] == 0                        # γ→0 at EMA 0
+
+    def test_adaptive_state_resets_at_retirement(self, tiny4):
+        """A retired slot hands the NEXT occupant a full-γ cap and an
+        optimistic EMA — per-slot adaptation never leaks across
+        requests."""
+        import numpy as np
+        cfg, params = tiny4
+        eng = self._eng(params, cfg, spec_gamma=2, draft_layers=1)
+        eng.submit([1, 2, 3], 10)
+        eng.drain()
+        assert (eng._gcap == eng.spec_gamma).all()
+        assert (np.asarray(eng._accept_ema) == 1.0).all()
+
+    def test_int8_kv_verify_parity_class(self, tiny4):
+        """int8 pages under the verify path: the engine completes every
+        request and stays in the dense int8 engine's tolerance class
+        (quantization is lossy; most tokens match the exact path)."""
+        cfg, params = tiny4
+        eng = self._eng(params, cfg, kv_int8=True, spec_gamma=2,
+                        draft_layers=1)
+        prompts = self._traffic(cfg)[:3]
+        rids = {eng.submit(p, n): (p, n) for p, n in prompts}
+        done = {r.rid: r.tokens for r in eng.drain()}
+        assert set(done) == set(rids)
+        total = match = 0
+        for rid, (p, n) in rids.items():
+            assert len(done[rid]) == n
+            g = solo(params, p, n, cfg)
+            total += n
+            match += sum(a == b for a, b in zip(done[rid], g))
+        assert match / total > 0.6, (match, total)
+
+    def test_single_token_request(self, tiny4):
+        cfg, params = tiny4
+        eng = self._eng(params, cfg, spec_gamma=2, draft_layers=1)
+        p = [9, 8, 7]
+        rid = eng.submit(p, 1)
+        done = eng.drain()
+        assert done[0].rid == rid
+        assert done[0].tokens == solo(params, p, 1, cfg)
+
+    @pytest.mark.parametrize("gamma", [0, 2])
+    def test_collect_overlap_parity(self, tiny4, gamma):
+        """Double-buffered collect (tick N+1 dispatched before tick
+        N's readout) changes latency, never tokens — in both the block
+        and the speculative tick modes."""
+        cfg, params = tiny4
+        eng = self._eng(params, cfg, n_slots=2, collect_overlap=True,
+                        spec_gamma=gamma,
+                        draft_layers=1 if gamma else None)
+        prompts = self._traffic(cfg)
+        rids = {}
+        for p, n in prompts[:3]:
+            rids[eng.submit(p, n)] = (p, n)
+        eng.step()
+        for p, n in prompts[3:]:
+            rids[eng.submit(p, n)] = (p, n)
+        done = {r.rid: r.tokens for r in eng.drain()}
+        for rid, (p, n) in rids.items():
+            assert done[rid] == solo(params, p, n, cfg), rid
+        assert eng.overlap_ms, "steady-state ticks must have overlapped"
+
+    def test_validation(self, tiny4):
+        cfg, params = tiny4
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(params, cfg, n_slots=1,
+                              prompt_buckets=(8,), spec_gamma=2)
+        with pytest.raises(ValueError, match="greedy"):
+            self._eng(params, cfg, sampling=True, top_k=4, spec_gamma=2)
+        with pytest.raises(ValueError, match="draft_layers"):
+            self._eng(params, cfg, spec_gamma=2,
+                      draft_layers=cfg.n_layers + 1)
+        with pytest.raises(ValueError, match="page_size"):
+            self._eng(params, cfg, spec_gamma=8)   # γ+1 > page 8
+        from kubegpu_tpu.models.moe import MoEConfig, moe_init
+        mcfg = MoEConfig.tiny(max_seq_len=64)
+        mparams = moe_init(jax.random.PRNGKey(2), mcfg)
+        with pytest.raises(ValueError, match="Llama"):
+            ContinuousBatcher(mparams, mcfg, n_slots=1,
+                              prompt_buckets=(8,), paged=True,
+                              page_size=8, spec_gamma=2)
