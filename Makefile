@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native asan test bench bench-smoke clean
+.PHONY: all native asan test bench bench-smoke chaos-smoke clean
 
 all: native
 
@@ -26,6 +26,13 @@ bench-smoke:                    # serving bench legs at tiny CPU configs
 	# equal-chip tp-vs-dp A/B) runs for real, not as skip rows
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py -q
+
+chaos-smoke:                    # seeded chaos scenario matrix (ISSUE 4):
+	# replica kill / dispatch failure / NaN quarantine / tick stall —
+	# every request exactly once, tokens bit-exact vs fault-free.
+	# 8 virtual devices so dp failover runs for real.
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_chaos.py -q
 
 clean:
 	$(MAKE) -C kubegpu_tpu/allocator/csrc clean

@@ -291,7 +291,7 @@ def _probe_block_cost(probe, iters: int) -> float:
 
         def chain(st):
             pool, tok = st
-            _, tok, _, pool = probe._fns[0](
+            _, tok, _, pool, _ = probe._fns[0](
                 probe.params, pool, probe._pt_dev, probe._tvec_dev,
                 probe._tpad_dev, tok, probe.pos, act, probe.temps,
                 probe._base_key, jnp.int32(0))
@@ -301,7 +301,7 @@ def _probe_block_cost(probe, iters: int) -> float:
 
         def chain(st):
             cache, tok = st
-            _, tok, _, cache = probe._fns[0](
+            _, tok, _, cache, _ = probe._fns[0](
                 probe.params, cache, tok, probe.pos, act, probe.temps,
                 probe._base_key, jnp.int32(0))
             return cache, tok
@@ -413,7 +413,7 @@ def _probe_spec_cost(probe, iters: int) -> float:
 
     def chain(st):
         pool, tok, pos = st
-        _, _, _, tok, pos, pool = probe._fns[5](
+        _, _, _, _, tok, pos, pool = probe._fns[5](
             probe.params, probe._draft_params, pool, probe._pt_dev,
             probe._tvec_dev, probe._tpad_dev, tok, pos, act, gcap)
         return pool, tok, pos
@@ -577,6 +577,153 @@ def _cb_spec_bench(params, cfg, slots: int, prompt: int, new: int,
         row["best_gamma"] = best[1]
         row["best_acceptance"] = round(best[2], 3)
         out["by_tp"][name] = row
+    return out
+
+
+def _cb_chaos_bench(params, cfg, slots: int, prompt: int, new: int,
+                    stride: int, page: int, reqs: int,
+                    seed: int = 0) -> dict:
+    """Chaos-hardened serving row (ISSUE 4 tentpole): the SAME request
+    window drained fault-free and under a seeded injected-fault matrix
+    (replica kill, transient dispatch failure, NaN-logit poisoning,
+    watchdog tick stall), asserting the recovery contract the issue
+    demands — zero lost requests, zero duplicated completions, and
+    BIT-EXACT tokens for every replayed stream (greedy replay re-
+    conditions on the accepted prefix) — while reporting failover and
+    replay timings next to the fault-free baseline.  Throughput here
+    is raw wall ("weather"): the row's claim is exactly-once + parity
+    under faults plus the recovery cost, not a kernel speedup."""
+    import jax
+    import numpy as np
+
+    from kubegpu_tpu.models.serve import (
+        ContinuousBatcher,
+        DataParallelServePool,
+    )
+    from kubegpu_tpu.obs.chaos import ChaosEvent, ChaosInjector
+    from kubegpu_tpu.obs.metrics import MetricsRegistry, percentiles
+
+    # replay prompts grow by the accepted tokens, so the bucket ladder
+    # must cover prompt + new (page-aligned)
+    buckets = (prompt, prompt + ((new + page - 1) // page) * page)
+    cb_len = buckets[-1] + new + stride + 8
+    base = np.arange(prompt) % cfg.vocab_size
+    stream = [((base + 3 * i) % cfg.vocab_size, new)
+              for i in range(reqs)]
+    n_dev = len(jax.devices())
+
+    def pool_kw():
+        return dict(n_slots=slots, max_len=cb_len, stride=stride,
+                    prompt_buckets=buckets, paged=True, page_size=page,
+                    prefix_cache=True)
+
+    def run(make, warm=False):
+        obj = make()
+        if warm:
+            # the watchdog scenario must not count compile time as a
+            # stall — warmup() compiles every (bucket, wave) the run
+            # AND its replays can hit, so steady ticks are compile-free
+            obj.warmup()
+        t0 = time.perf_counter()
+        rids = [obj.submit(p, n) for p, n in stream]
+        seen: dict[int, list[int]] = {}
+        dup = 0
+        for r in obj.drain():
+            if r.rid in seen:
+                dup += 1
+            seen[r.rid] = (None if r.error is not None
+                           else list(r.tokens))
+        wall = time.perf_counter() - t0
+        lost = len([r for r in rids if r not in seen])
+        return obj, [seen.get(r) for r in rids], wall, lost, dup
+
+    # -- fault-free baseline (dp=2 when devices allow, else dp=1) ----
+    dp = 2 if n_dev >= 2 else 1
+    eng0, base_tokens, base_wall, lost0, dup0 = run(
+        lambda: DataParallelServePool(params, cfg, dp=dp, tp=1,
+                                      **pool_kw()))
+    total = sum(len(t) for t in base_tokens if t)
+    out = {
+        "protocol": "seeded_chaos_matrix",
+        "seed": seed, "requests": reqs, "new_tokens": new,
+        "dp": dp, "n_slots": slots,
+        "fault_free": {
+            "completed": len([t for t in base_tokens if t is not None]),
+            "lost": lost0, "duplicated": dup0, "tokens": total,
+            "wall_ms_raw_weather": round(base_wall * 1e3, 1),
+            "tokens_per_s_raw_weather": round(total / base_wall, 1),
+        },
+        "scenarios": {},
+    }
+
+    def scenario(name, make, wall_extra_s=0.0, warm=False):
+        reg = MetricsRegistry()
+        obj, toks, wall, lost, dup = run(lambda: make(reg), warm=warm)
+        exact = toks == base_tokens
+        row = {
+            "completed": len([t for t in toks if t is not None]),
+            "lost": lost, "duplicated": dup,
+            "bit_exact_vs_fault_free": exact,
+            "wall_ms_raw_weather": round(wall * 1e3, 1),
+            "tokens_per_s_raw_weather": round(
+                total / max(wall - wall_extra_s, 1e-9), 1),
+            "failovers": getattr(obj, "failovers", 0),
+            "requests_retried": int(
+                reg.counter("serve_requests_retried")),
+            "slots_quarantined": int(
+                reg.counter("serve_slots_quarantined")),
+            "dispatch_failures": int(
+                reg.counter("serve_dispatch_failures")),
+            "replay_ms": {k: round(v, 3) for k, v in percentiles(
+                getattr(obj, "replay_ms", [])).items()},
+        }
+        out["scenarios"][name] = row
+
+    # replica kill at a seeded tick — dp failover + replay
+    kill_tick = 2 + seed % 3
+    if dp >= 2:
+        scenario("replica_kill", lambda reg: DataParallelServePool(
+            params, cfg, dp=dp, tp=1, metrics=reg,
+            chaos={0: ChaosInjector(
+                [ChaosEvent(tick=kill_tick, kind="kill_replica")])},
+            **pool_kw()))
+    else:
+        out["scenarios"]["replica_kill"] = {"skipped": "needs 2 devices"}
+
+    # one transient dispatch failure — retried in place, no failover
+    scenario("dispatch_failure", lambda reg: DataParallelServePool(
+        params, cfg, dp=1, tp=1, metrics=reg,
+        chaos={0: ChaosInjector(
+            [ChaosEvent(tick=1, kind="fail_dispatch")])},
+        **pool_kw()))
+
+    # NaN-logit poisoning — slot quarantine + engine-level replay
+    scenario("nan_logits", lambda reg: DataParallelServePool(
+        params, cfg, dp=1, tp=1, metrics=reg,
+        chaos={0: ChaosInjector(
+            [ChaosEvent(tick=2 + seed % 2, kind="nan_logits")])},
+        **pool_kw()))
+
+    # watchdog tick stall — declared dead, pool fails over.  The
+    # injected sleep is subtracted from the throughput figure (it is
+    # scenario cost, not engine cost); completions/parity are the row.
+    stall_s = 1.2
+    if dp >= 2:
+        scenario("tick_stall", lambda reg: DataParallelServePool(
+            params, cfg, dp=dp, tp=1, metrics=reg,
+            tick_deadline_s=stall_s / 2,
+            chaos={1: ChaosInjector(
+                [ChaosEvent(tick=1, kind="stall_tick",
+                            stall_s=stall_s)])},
+            **pool_kw()), wall_extra_s=stall_s, warm=True)
+    else:
+        out["scenarios"]["tick_stall"] = {"skipped": "needs 2 devices"}
+
+    live = [r for r in out["scenarios"].values() if "skipped" not in r]
+    out["all_bit_exact"] = all(r["bit_exact_vs_fault_free"]
+                               for r in live)
+    out["total_lost"] = sum(r["lost"] for r in live)
+    out["total_duplicated"] = sum(r["duplicated"] for r in live)
     return out
 
 
@@ -1038,7 +1185,7 @@ def _cb_ab_bench(qparams, cfg, slots: int, prompt: int, new: int,
                 # re-uploading per call would re-add the very dispatch
                 # overhead the engine's dirty-tracking removed
                 pool, tok = st
-                _, tok, _, pool = probe._fns[0](
+                _, tok, _, pool, _ = probe._fns[0](
                     qparams, pool, probe._pt_dev, probe._tvec_dev,
                     probe._tpad_dev, tok, probe.pos, act,
                     probe.temps, probe._base_key, jnp.int32(0))
@@ -1049,7 +1196,7 @@ def _cb_ab_bench(qparams, cfg, slots: int, prompt: int, new: int,
 
             def chain(st):
                 cache, tok = st
-                _, tok, _, cache = probe._fns[0](
+                _, tok, _, cache, _ = probe._fns[0](
                     qparams, cache, tok, probe.pos, act, probe.temps,
                     probe._base_key, jnp.int32(0))
                 return cache, tok
@@ -1705,6 +1852,9 @@ def run_serving_bench_smoke() -> dict:
             page=8, reqs=4, iters=2, draft_layers=2, gammas=(3,),
             degrees=(1, 2),
             prompts=[sp_cyc[i % 8:][:16] for i in range(4)]),
+        "cb_chaos": _cb_chaos_bench(
+            params, cfg, slots=2, prompt=16, new=8, stride=2, page=8,
+            reqs=6),
     }
 
 

@@ -59,6 +59,24 @@ engine.  dp scale-out is :class:`DataParallelServePool`: independent
 engine replicas behind one admission queue, no cross-replica
 collective ever.
 
+The serving stack is CHAOS-HARDENED (the r9 tentpole): every
+``_Request`` keeps its prompt + accepted tokens host-side, so any
+fault resolves to bit-exact greedy REPLAY (prompt + accepted, the
+remaining budget — prefix-cache-accelerated when the original pages
+are registered).  The engine defends itself per tick: non-finite
+logits quarantine the offending SLOT (never the batch), a watchdog
+(``tick_deadline_s``) declares a stalled replica dead instead of
+letting ``drain()`` wedge, unfittable admissions are SHED (failed
+loudly) instead of deadlocking the FIFO queue, and repeated
+zero-acceptance verify ticks degrade γ→0 engine-wide.
+:class:`DataParallelServePool` adds replica failover: a dead replica's
+resident requests replay onto healthy replicas with exactly-once
+completion, driven either by the engine raising
+:class:`~kubegpu_tpu.obs.chaos.ReplicaDeadError` or by a control-plane
+gang eviction observed on the apiserver watch stream
+(``watch_health``).  ``obs/chaos.py`` injects all of these faults
+deterministically from a seed.
+
 Correctness contract: slots are independent batch rows — a request's
 attention/FFN math never mixes with its neighbors'.  Tokens are
 bit-identical to a solo ``greedy_generate`` at the tested
@@ -94,6 +112,11 @@ from kubegpu_tpu.models.decode import (
     init_kv_cache,
 )
 from kubegpu_tpu.models.llama import LlamaConfig, _rmsnorm
+from kubegpu_tpu.obs.chaos import (
+    DispatchFailure,
+    ReplicaDeadError,
+    TickStallError,
+)
 from kubegpu_tpu.ops.flash_attention import NEG_INF
 
 
@@ -367,22 +390,28 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
         shape = cache["k"].shape            # [L, B, Hkv, S, D]
         buf = {n: jnp.zeros(shape[:3] + (stride,) + shape[4:],
                             cache[n].dtype) for n in ("k", "v")}
+        bad0 = jnp.zeros(tokens.shape, bool)
 
         def step(carry, xs):
-            tokens, pos, buf = carry
+            tokens, pos, buf, bad = carry
             j, k_ = xs
             logits, buf = _row_step_buffered(
                 params, tokens, cache, buf, flush_pos, pos, j, cfg,
                 ffn=ffn)
+            # invalid-logit self-defense: a row whose logits went
+            # non-finite (NaN weights/KV, kernel fault) is flagged so
+            # the host quarantines THAT slot instead of letting the
+            # garbage argmax masquerade as a token
+            bad = bad | jnp.any(~jnp.isfinite(logits), axis=-1)
             nxt = _pick(logits, temps, k_).astype(tokens.dtype)
             nxt = jnp.where(active, nxt, tokens)
             pos = jnp.where(active, pos + 1, pos)
-            return (nxt, pos, buf), nxt
+            return (nxt, pos, buf, bad), nxt
 
-        (tokens, pos, buf), block = lax.scan(
-            step, (tokens, pos, buf), (jnp.arange(stride), keys))
+        (tokens, pos, buf, bad), block = lax.scan(
+            step, (tokens, pos, buf, bad0), (jnp.arange(stride), keys))
         cache = _flush_buffer(cache, buf, flush_pos)
-        return block, tokens, pos, cache
+        return block, tokens, pos, cache, bad.astype(jnp.int32)
 
     @jax.jit
     def prefill_wave(params, padded_prompts, true_lens, temps_w,
@@ -582,22 +611,27 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         buf = {n: jnp.zeros((shape[0], n_slots, shape[2], stride,
                              shape[4]), lcfg.jdtype)
                for n in ("k", "v")}
+        bad0 = jnp.zeros(tokens.shape, bool)
 
         def step(carry, xs):
-            tokens, pos, buf = carry
+            tokens, pos, buf, bad = carry
             j, k_ = xs
             logits, buf = _paged_row_step(
                 params, tokens, pool, pt, tvec, tpad, d0, buf, pos, j,
                 lcfg, interpret, ffn=ffn, tp_axis=tp_axis)
+            # per-slot invalid-logit flag (slots are independent rows,
+            # so a poisoned page NaNs exactly one row's logits — the
+            # host quarantines that slot, never the batch)
+            bad = bad | jnp.any(~jnp.isfinite(logits), axis=-1)
             nxt = _pick(logits, temps, k_).astype(tokens.dtype)
             nxt = jnp.where(active, nxt, tokens)
             pos = jnp.where(active, pos + 1, pos)
-            return (nxt, pos, buf), nxt
+            return (nxt, pos, buf, bad), nxt
 
-        (tokens, pos, buf), block = lax.scan(
-            step, (tokens, pos, buf), (jnp.arange(stride), keys))
+        (tokens, pos, buf, bad), block = lax.scan(
+            step, (tokens, pos, buf, bad0), (jnp.arange(stride), keys))
         pool = _flush_buffer_paged(pool, buf, pt, tpad, d0, page_size)
-        return block, tokens, pos, pool
+        return block, tokens, pos, pool, bad.astype(jnp.int32)
 
     def _pw_body(params, padded_prompts, true_lens, temps_w,
                  base_key, rid0):
@@ -992,6 +1026,10 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             chunk = jnp.concatenate([tokens[:, None], drafted], axis=1)
             vlogits, pool = _verify_fwd(params, chunk, pool, pt, tvec,
                                         tpad, d0, pos)
+            # invalid-logit flag over every verify position: a slot
+            # whose verify went non-finite emits garbage acceptance —
+            # the host quarantines it before its tokens count
+            badv = jnp.any(~jnp.isfinite(vlogits), axis=(1, 2))
             f = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
             matched, take = spec_acceptance(drafted, f, gcap)
             corr = jnp.take_along_axis(f, take[:, None], axis=1)[:, 0]
@@ -1004,7 +1042,8 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             tokens = jnp.where(active, corr.astype(tokens.dtype),
                                tokens)
             pos = jnp.where(active, pos + take + 1, pos)
-            return emit, take, matched, tokens, pos, pool
+            return emit, take, matched, badv.astype(jnp.int32), \
+                tokens, pos, pool
 
         _spec_body = _spec_tick_body
 
@@ -1045,7 +1084,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
     _sm_block = shard_map(
         _block_body, mesh=mesh,
         in_specs=(pspec, pool_spec) + (rep,) * 9,
-        out_specs=(rep, rep, rep, pool_spec))
+        out_specs=(rep, rep, rep, pool_spec, rep))
 
     @functools.partial(jax.jit, donate_argnames=("pool",))
     def decode_block(params, pool, pt, tvec, tpad, tokens, pos, active,
@@ -1087,7 +1126,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         _sm_spec = shard_map(
             _spec_body, mesh=mesh,
             in_specs=(pspec, pspec, pool_spec) + (rep,) * 7,
-            out_specs=(rep,) * 5 + (pool_spec,))
+            out_specs=(rep,) * 6 + (pool_spec,))
 
         @functools.partial(jax.jit, donate_argnames=("pool",))
         def verify_block(params, dparams, pool, pt, tvec, tpad, tokens,
@@ -1116,6 +1155,24 @@ class _Request:
     # the whole prefix up to that page boundary matches); computed at
     # submit, empty unless the engine runs prefix caching
     prefix_keys: tuple = ()
+    # -- durability (ISSUE 4): the prompt lives HOST-side for the
+    # request's whole lifetime so quarantine/failover can replay it as
+    # prompt + accepted tokens (greedy replay is bit-exact — the
+    # accepted prefix conditions the same continuation).  ``admit_len``
+    # is the CURRENT admission's true prompt length: the original
+    # prompt at first admission, prompt + accepted at a replay.
+    prompt: object = None               # np.ndarray, set at submit
+    admit_len: int = 0
+    retries: int = 0                    # quarantine/replay attempts
+    not_before_tick: int = 0            # backoff gate for replays
+    deadline: float | None = None       # time.monotonic() cutoff
+    error: str | None = None            # set when the request FAILED
+
+    @property
+    def remaining_new(self) -> int:
+        """Tokens still owed: the budget minus what already landed
+        (non-zero ``tokens`` at admission means this is a replay)."""
+        return self.max_new_tokens - len(self.tokens)
 
 
 class ContinuousBatcher:
@@ -1168,7 +1225,11 @@ class ContinuousBatcher:
                  metrics=None, mesh=None,
                  spec_gamma: int = 0, draft_layers: int | None = None,
                  spec_adaptive: bool = True,
-                 collect_overlap: bool = False):
+                 collect_overlap: bool = False,
+                 chaos=None, tick_deadline_s: float | None = None,
+                 max_retries: int = 2,
+                 spec_degrade_after: int | None = None,
+                 debug_invariants: bool = False):
         # model families: a MoEConfig serves through the same engine —
         # its Llama backbone drives attention/cache shapes, the routed
         # expert FFN rides the engine's ffn hook (VERDICT r4 weak #6:
@@ -1474,6 +1535,47 @@ class ContinuousBatcher:
         # computing — the latency the overlap hides (exported as the
         # ``serve_collect_overlap_ms`` histogram via ``metrics``)
         self.overlap_ms: list[float] = []
+        # -- fault injection + self-defense (ISSUE 4 tentpole) --------
+        # ``chaos``: a ChaosInjector consulted at every dispatch;
+        # ``tick_deadline_s``: watchdog — a tick whose wall time
+        # exceeds it declares this replica STALLED (TickStallError, a
+        # ReplicaDeadError: a replica that stalls once can wedge
+        # drain() forever, so policy is failover, not waiting);
+        # ``max_retries`` bounds per-request quarantine/replay cycles;
+        # ``spec_degrade_after``: N consecutive verify ticks with ZERO
+        # accepted drafts across every active slot degrade the engine
+        # to γ=0 (the plain decode-block path — bit-exact, since the
+        # spec engine only ever amortizes dispatches);
+        # ``debug_invariants`` runs the page-leak detector every tick.
+        self.chaos = chaos
+        self.tick_deadline_s = tick_deadline_s
+        self.max_retries = int(max_retries)
+        self.spec_degrade_after = spec_degrade_after
+        self.debug_invariants = bool(debug_invariants)
+        self.dead: str | None = None      # death reason, once dead
+        self.spec_degraded = False
+        self._spec_reject_streak = 0
+        self.slots_quarantined = 0
+        self.requests_retried = 0
+        self.requests_shed = 0
+        self.dispatch_failures = 0
+        self.replay_ms: list[float] = []
+        self._jseed = seed
+        # step counter for replay backoff: advances every step() even
+        # when nothing dispatches (self._tick does not — an idle
+        # engine would never clear a replay's backoff gate)
+        self._step_count = 0
+        # slots admitted whose prefill-produced first token has not
+        # been consumed yet (replaces the r3 ``not req.tokens`` test,
+        # which a replayed request — non-empty tokens — would break)
+        self._await_first: set[int] = set()
+        # shed/cancelled requests surfaced by the next step()'s return
+        self._failed: list[_Request] = []
+        # requests that FINISHED in the same step() that killed the
+        # replica — the pool harvests these at failover so a completed
+        # request is never replayed (exactly-once)
+        self._orphans: list[_Request] = []
+        self._inflight_spec = False       # layout of the in-flight fetch
 
     def warmup(self) -> None:
         """Compile every executable this engine can hit — the decode
@@ -1510,17 +1612,19 @@ class ContinuousBatcher:
                     jnp.asarray(self._pt), jnp.asarray(self._tvec),
                     jnp.asarray(self._tpad), self.tokens, self.pos,
                     jnp.asarray(self.active), jnp.asarray(self._gcap))
-                return out[0], None, None, out[5]
+                return out[0], out[6]
             if self.paged:
-                return decode_block(
+                out = decode_block(
                     self.params, scratch, jnp.asarray(self._pt),
                     jnp.asarray(self._tvec), jnp.asarray(self._tpad),
                     self.tokens, self.pos, jnp.asarray(self.active),
                     self.temps, self._base_key, jnp.int32(0))
-            return decode_block(
-                self.params, scratch, self.tokens, self.pos,
-                jnp.asarray(self.active), self.temps, self._base_key,
-                jnp.int32(0))
+            else:
+                out = decode_block(
+                    self.params, scratch, self.tokens, self.pos,
+                    jnp.asarray(self.active), self.temps,
+                    self._base_key, jnp.int32(0))
+            return out[0], out[3]
 
         for bucket in self.prompt_buckets:
             k = 1
@@ -1544,7 +1648,7 @@ class ContinuousBatcher:
                 jnp.ones((1,), jnp.int32), jnp.zeros((1,), jnp.float32),
                 self._base_key, jnp.int32(0))
             outs.append(tok)
-        blk, _, _, scratch = block(scratch)
+        blk, scratch = block(scratch)
         outs.append(blk)
         for o in outs:   # block until every compile finished
             np.asarray(o)
@@ -1552,9 +1656,13 @@ class ContinuousBatcher:
     # -- submission -----------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,
-               temperature: float = 0.0) -> int:
+               temperature: float = 0.0,
+               deadline_s: float | None = None) -> int:
         """Enqueue a request.  ``prompt``: 1-D int sequence;
-        ``temperature`` 0 decodes greedily, > 0 samples."""
+        ``temperature`` 0 decodes greedily, > 0 samples;
+        ``deadline_s`` (optional) cancels the request if it has not
+        completed that many seconds from now (it returns FAILED with
+        ``error='deadline exceeded'`` — partial tokens preserved)."""
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -1609,7 +1717,10 @@ class ContinuousBatcher:
         req = _Request(rid=self._next_rid, prompt_len=t,
                        max_new_tokens=max_new_tokens,
                        temperature=float(temperature),
-                       prefix_keys=keys)
+                       prefix_keys=keys, prompt=prompt_np,
+                       admit_len=t,
+                       deadline=(time.monotonic() + deadline_s
+                                 if deadline_s is not None else None))
         self._next_rid += 1
         self.queue.append((req, padded))
         return req.rid
@@ -1701,21 +1812,47 @@ class ContinuousBatcher:
             self._prefix_cache[key] = p
             self._page_key[p] = key
 
+    def _shed(self, req: _Request, why: str) -> None:
+        """Graceful degradation: fail ONE admission instead of letting
+        it deadlock the FIFO queue (it is surfaced as a FAILED request
+        by the next step() return, never silently dropped)."""
+        req.done = True
+        req.error = why
+        self.requests_shed += 1
+        if self._metrics is not None:
+            self._metrics.inc("serve_requests_shed")
+        self._failed.append(req)
+
     def _admit(self) -> None:
         prefill_wave, adopt_wave = self._fns[1], self._fns[2]
         free = [s for s in range(self.n_slots)
                 if s not in self.slot_req]
         while free and self.queue:
+            req0, p0 = self.queue[0]
+            if req0.not_before_tick > self._step_count:
+                # replay backoff gate: a quarantined request waits out
+                # its jittered backoff at the queue front (FIFO is
+                # preserved; the delay is a few ticks)
+                break
             if self.paged:
                 # page-admission gate: the queue FRONT must fit (FIFO
                 # is preserved — nothing jumps a request that is only
                 # waiting for pages).  Aliased prefix pages don't count
                 # against the ask, and unreferenced cached pages count
                 # as reclaimable capacity.
-                req0, p0 = self.queue[0]
                 hits0 = self._prefix_hit_run(req0)
-                if (self._pages_needed(req0.max_new_tokens, p0.shape[1])
-                        - hits0) > self._available_pages():
+                need0 = self._pages_needed(req0.remaining_new,
+                                           p0.shape[1])
+                if need0 - hits0 > self.total_pages:
+                    # pool-exhaustion backpressure: this admission can
+                    # NEVER fit (even with every page free) — a replay
+                    # whose prompt grew past the pool.  Shed it instead
+                    # of deadlocking the queue behind it.
+                    self.queue.popleft()
+                    self._shed(req0, f"shed: needs {need0 - hits0} "
+                               f"pages, pool has {self.total_pages}")
+                    continue
+                if (need0 - hits0) > self._available_pages():
                     break
                 # prefix-aliased tails and long prompts (chunked mode)
                 # admit per-slot through the chunk path — no wave
@@ -1758,7 +1895,7 @@ class ContinuousBatcher:
                 # shrink the wave until its TOTAL page need fits (the
                 # front alone was already checked, so k >= 1 survives)
                 while k > 1 and sum(
-                        self._pages_needed(r.max_new_tokens, bucket)
+                        self._pages_needed(r.remaining_new, bucket)
                         for r, _ in list(self.queue)[:k]
                         ) > self._available_pages():
                     k //= 2
@@ -1766,7 +1903,7 @@ class ContinuousBatcher:
             slots = [free.pop(0) for _ in range(k)]
             padded = jnp.concatenate([p for _, p in wave], axis=0)
             true_lens = jnp.asarray(
-                [r.prompt_len for r, _ in wave], jnp.int32)
+                [r.admit_len for r, _ in wave], jnp.int32)
             temps_w = jnp.asarray(
                 [r.temperature for r, _ in wave], jnp.float32)
             firsts, cache_w = prefill_wave(
@@ -1780,12 +1917,12 @@ class ContinuousBatcher:
                 n_prompt_pages = bucket // self.page_size
                 page_dst = np.zeros((k, n_prompt_pages), np.int32)
                 for i, (slot, (req, _)) in enumerate(zip(slots, wave)):
-                    need = self._pages_needed(req.max_new_tokens, bucket)
+                    need = self._pages_needed(req.remaining_new, bucket)
                     pages = self._alloc_pages(need)
                     self._slot_pages[slot] = pages
                     self._pt[slot, :] = 0
                     self._pt[slot, :need] = pages
-                    self._tvec[slot] = req.prompt_len
+                    self._tvec[slot] = req.admit_len
                     self._tpad[slot] = bucket
                     self._tables_dirty = True
                     page_dst[i] = pages[:n_prompt_pages]
@@ -1803,12 +1940,14 @@ class ContinuousBatcher:
                     self.tokens, self.pos, self.temps, k)
             self.wave_log.append((k, bucket))
             self._tick_work.append(("wave", k, bucket))
-            self.prefill_tokens += sum(r.prompt_len for r, _ in wave)
+            self.prefill_tokens += sum(r.admit_len for r, _ in wave)
             for slot, (req, _) in zip(slots, wave):
-                self.active[slot] = req.max_new_tokens > 1
+                remaining = req.remaining_new
+                self.active[slot] = remaining > 1
                 self.slot_req[slot] = req
+                self._await_first.add(slot)
                 self.emitted_tokens += 1
-                if req.max_new_tokens <= 1:
+                if remaining <= 1:
                     req.done = True
             if self.paged and self.prefix_cache_enabled:
                 # the adopt dispatch above is ordered before any later
@@ -1829,13 +1968,13 @@ class ContinuousBatcher:
         overwrites before any position there becomes valid."""
         req, padded = self.queue.popleft()
         bucket = padded.shape[1]
-        need = self._pages_needed(req.max_new_tokens, bucket)
+        need = self._pages_needed(req.remaining_new, bucket)
         aliased = self._alias_pages(req, hits)
         pages = aliased + self._alloc_pages(need - hits)
         self._slot_pages[slot] = pages
         self._pt[slot, :] = 0
         self._pt[slot, :need] = pages
-        self._tvec[slot] = req.prompt_len
+        self._tvec[slot] = req.admit_len
         self._tpad[slot] = bucket
         self._tables_dirty = True
         if hits:
@@ -1866,7 +2005,7 @@ class ContinuousBatcher:
         for slot in sorted(self._prefilling):
             st = self._prefilling[slot]
             req = st["req"]
-            t, c, start = req.prompt_len, self.prefill_chunk, st["next"]
+            t, c, start = req.admit_len, self.prefill_chunk, st["next"]
             chunk = lax.dynamic_slice_in_dim(st["padded"], start, c,
                                              axis=1)
             pt_row = lax.dynamic_slice_in_dim(self._pt_dev, slot, 1,
@@ -1890,16 +2029,240 @@ class ContinuousBatcher:
                     jnp.full((1,), req.temperature, jnp.float32))
                 del self._prefilling[slot]
                 self._register_prefix(req, self._slot_pages[slot])
-                self.active[slot] = req.max_new_tokens > 1
+                remaining = req.remaining_new
+                self.active[slot] = remaining > 1
+                self._await_first.add(slot)
                 self.emitted_tokens += 1
-                if req.max_new_tokens <= 1:
+                if remaining <= 1:
                     req.done = True
+
+    # -- fault injection + self-defense (ISSUE 4) -----------------------
+
+    def _die(self, reason: str) -> None:
+        """Mark this replica dead and raise; every later step()
+        re-raises.  Host-side request state (slot_req/queue/tokens)
+        stays intact — the pool's failover path harvests it."""
+        self.dead = reason
+        if self._metrics is not None:
+            self._metrics.inc("serve_replica_deaths")
+        raise ReplicaDeadError(reason)
+
+    def _chaos_gate(self) -> None:
+        """Apply every chaos event due at this tick, BEFORE the real
+        dispatch mutates state (so a failed dispatch retries the exact
+        same functional call)."""
+        if self.chaos is None:
+            return
+        due = self.chaos.take(self._tick)
+        for i, ev in enumerate(due):
+            if ev.kind == "kill_replica":
+                self._die(f"chaos: replica killed at tick {self._tick}")
+            elif ev.kind == "stall_tick":
+                time.sleep(ev.stall_s)
+            elif ev.kind == "nan_logits":
+                if not self._poison_one_slot():
+                    self.chaos.defer(ev, self._tick + 1)
+            elif ev.kind == "fail_dispatch":
+                for rest in due[i + 1:]:
+                    self.chaos.defer(rest, self._tick)
+                raise DispatchFailure(
+                    f"chaos: dispatch failed at tick {self._tick}")
+
+    def poison_slot(self, slot: int) -> None:
+        """Chaos hook: NaN one slot's K/V history (paged: its first
+        decode page — never prefix-registered, so the poison cannot be
+        aliased into another request; dense: its cache row).  The
+        slot's next logits go non-finite while its neighbors stay
+        exact — slots are independent batch rows."""
+        if self.paged:
+            pid = int(self._pt[slot,
+                               int(self._tpad[slot]) // self.page_size])
+            leaf = "k_scale" if "k_scale" in self.pool else "k"
+            self.pool[leaf] = self.pool[leaf].at[:, pid].set(jnp.nan)
+        else:
+            self.cache["k"] = self.cache["k"].at[:, slot].set(jnp.nan)
+
+    def _poison_one_slot(self) -> bool:
+        """Poison the lowest eligible slot (active, past its first
+        decode flush so the paged kernel actually reads the poisoned
+        page); False defers the event to the next tick."""
+        for slot in sorted(self.slot_req):
+            if slot in self._prefilling or not self.active[slot]:
+                continue
+            if self.paged:
+                flushed = int(np.asarray(self.pos)[slot]) \
+                    - int(self._tvec[slot])
+                if flushed < 1:
+                    continue
+            self.poison_slot(slot)
+            return True
+        return False
+
+    def _backoff_ticks(self, req: _Request) -> int:
+        """Exponential backoff in ticks with deterministic per-(rid,
+        attempt) jitter — retries spread out instead of thundering
+        back into the same admission window."""
+        base = min(1 << (req.retries - 1), 8)
+        j = int(np.random.default_rng(
+            abs(hash((self._jseed, req.rid, req.retries)))
+        ).integers(0, base + 1))
+        return base + j
+
+    def _replay(self, req: _Request, why: str) -> None:
+        """Re-admit a faulted request: replay prompt = original prompt
+        + accepted tokens, budget = what is still owed.  Greedy replay
+        is BIT-EXACT (the accepted prefix conditions the same
+        continuation), and with prefix caching on the original
+        prompt's registered pages make the replay prefill mostly
+        aliasing.  Bounded by ``max_retries`` with jittered
+        exponential backoff; an unfittable replay is shed, never
+        parked."""
+        req.retries += 1
+        if req.retries > self.max_retries:
+            req.done = True
+            req.error = f"failed after {req.retries - 1} retries: {why}"
+            self._failed.append(req)
+            return
+        replay = (np.concatenate([req.prompt,
+                                  np.asarray(req.tokens, np.int32)])
+                  if req.tokens else req.prompt)
+        t = int(replay.shape[0])
+        bucket = next((b for b in self.prompt_buckets if b >= t), None)
+        if bucket is None:
+            self._shed(req, f"replay prompt {t} exceeds largest "
+                       f"bucket {self.prompt_buckets[-1]}")
+            return
+        keys: tuple = ()
+        if self.paged and self.prefix_cache_enabled:
+            n_cacheable = (t - 1) // self.page_size
+            keys = tuple(
+                hash(replay[:(i + 1) * self.page_size].tobytes())
+                for i in range(n_cacheable))
+        req.prefix_keys = keys
+        req.admit_len = t
+        req.not_before_tick = self._step_count \
+            + self._backoff_ticks(req)
+        padded = jnp.zeros((1, bucket), jnp.int32) \
+            .at[0, :t].set(jnp.asarray(replay))
+        self.queue.append((req, padded))
+        self.requests_retried += 1
+        if self._metrics is not None:
+            self._metrics.inc("serve_requests_retried")
+
+    def _quarantine(self, slot: int, req: _Request) -> None:
+        """Invalid-logit self-defense: pull the offending slot out of
+        the batch (its math never mixed with its neighbors'), drop the
+        poisoned tick's tokens, release its pages, and replay the
+        request from its last good token."""
+        self.slots_quarantined += 1
+        if self._metrics is not None:
+            self._metrics.inc("serve_slots_quarantined")
+        del self.slot_req[slot]
+        self.active[slot] = False
+        self._prefilling.pop(slot, None)
+        self._await_first.discard(slot)
+        self._release_pages(slot)
+        if self.spec_gamma:
+            self._accept_ema[slot] = 1.0
+            self._gcap[slot] = self.spec_gamma
+        self._replay(req, "non-finite logits quarantined")
+
+    def _cancel_req(self, req: _Request, why: str) -> None:
+        """Remove a request from wherever it lives (queue, slot,
+        chunk-prefill) and mark it failed with its partial tokens."""
+        req.done = True
+        req.error = why
+        for i, (r, _) in enumerate(self.queue):
+            if r.rid == req.rid:
+                del self.queue[i]
+                break
+        for slot, r in list(self.slot_req.items()):
+            if r.rid == req.rid:
+                del self.slot_req[slot]
+                self.active[slot] = False
+                self._prefilling.pop(slot, None)
+                self._await_first.discard(slot)
+                self._release_pages(slot)
+                if self.spec_gamma:
+                    self._accept_ema[slot] = 1.0
+                    self._gcap[slot] = self.spec_gamma
+                break
+
+    def cancel(self, rid: int, reason: str = "canceled"):
+        """Cancel a queued or resident request.  Returns the request
+        (done, ``error`` set, partial tokens preserved) or None if the
+        rid is unknown/already finished.  The canceled request is
+        returned HERE, not from a later step()."""
+        for r, _ in self.queue:
+            if r.rid == rid:
+                self._cancel_req(r, reason)
+                return r
+        for r in self.slot_req.values():
+            if r.rid == rid:
+                self._cancel_req(r, reason)
+                return r
+        return None
+
+    def _expire_deadlines(self, finished: list) -> None:
+        """Cancel requests whose per-request deadline passed; they
+        surface as FAILED in this step's return."""
+        reqs = [r for r, _ in self.queue] + list(self.slot_req.values())
+        if not any(r.deadline is not None for r in reqs):
+            return
+        now = time.monotonic()
+        for req in reqs:
+            if req.deadline is not None and now > req.deadline:
+                self._cancel_req(req, "deadline exceeded")
+                finished.append(req)
+
+    def take_orphans(self) -> list[_Request]:
+        """Requests that FINISHED in the very step() that killed this
+        replica — the failover path collects them so a completed
+        request is never replayed (exactly-once completion)."""
+        out, self._orphans = self._orphans, []
+        return out
+
+    def _watchdog(self, t0: float, finished: list) -> None:
+        """Tick watchdog: a tick whose wall time blew the deadline
+        marks this replica STALLED.  Post-hoc by construction (a hung
+        device sync cannot be interrupted in-thread), but that is
+        exactly the drain()-wedging failure mode — policy is failover,
+        not waiting."""
+        if self.tick_deadline_s is None or self.dead is not None:
+            return
+        dt = time.perf_counter() - t0
+        if dt > self.tick_deadline_s:
+            self._orphans.extend(finished)
+            self.dead = (f"watchdog: tick {self._tick - 1} took "
+                         f"{dt * 1e3:.0f} ms > deadline "
+                         f"{self.tick_deadline_s * 1e3:.0f} ms")
+            if self._metrics is not None:
+                self._metrics.inc("serve_tick_stalls")
+            raise TickStallError(self.dead)
+
+    def _dispatch_with_retry(self) -> None:
+        """Bounded in-place retry on transient dispatch failures (the
+        chaos gate raises BEFORE the functional dispatch mutates
+        state, so a retry re-runs identical math); repeated failure
+        escalates to replica death."""
+        for _ in range(3):
+            try:
+                return self._dispatch_tick()
+            except DispatchFailure:
+                self.dispatch_failures += 1
+                if self._metrics is not None:
+                    self._metrics.inc("serve_dispatch_failures")
+        self._die("dispatch failed 3 times in a row")
 
     def _dispatch_tick(self) -> None:
         """Dispatch the next decode work for the CURRENT slot state —
-        a stride decode block, or (spec_gamma > 0) one speculative
-        verify tick — and fuse the in-flight host fetch (token slab +
-        per-slot accounting + every pending first token)."""
+        a stride decode block, or (spec_gamma > 0, not degraded) one
+        speculative verify tick — and fuse the in-flight host fetch
+        (token slab + per-slot bad-logit flags + per-slot accounting +
+        every pending first token)."""
+        if self.dead is not None:
+            raise ReplicaDeadError(self.dead)
+        self._chaos_gate()
         if self.paged and self._tables_dirty:
             # page table + per-row length scalars are device-resident
             # and re-uploaded only after admission/retirement mutated
@@ -1908,31 +2271,36 @@ class ContinuousBatcher:
             self._tvec_dev = jnp.asarray(self._tvec)
             self._tpad_dev = jnp.asarray(self._tpad)
             self._tables_dirty = False
-        if self.paged and self.spec_gamma:
-            (emit, take, matched, self.tokens, self.pos,
+        if self.paged and self.spec_gamma and not self.spec_degraded:
+            (emit, take, matched, badv, self.tokens, self.pos,
              self.pool) = self._fns[5](
                 self.params, self._draft_params, self.pool,
                 self._pt_dev, self._tvec_dev, self._tpad_dev,
                 self.tokens, self.pos, jnp.asarray(self.active),
                 jnp.asarray(self._gcap))
             self._spec_active = self.active.copy()
+            self._inflight_spec = True
             self._inflight = jnp.concatenate(
-                [emit.reshape(-1), take, matched, self.first_toks])
+                [emit.reshape(-1), take, matched, badv,
+                 self.first_toks])
         elif self.paged:
-            block, self.tokens, self.pos, self.pool = self._fns[0](
+            block, self.tokens, self.pos, self.pool, bad = self._fns[0](
                 self.params, self.pool, self._pt_dev,
                 self._tvec_dev, self._tpad_dev,
                 self.tokens, self.pos, jnp.asarray(self.active),
                 self.temps, self._base_key, jnp.int32(self._tick))
+            self._inflight_spec = False
             self._inflight = jnp.concatenate(
-                [block.reshape(-1), self.first_toks])
+                [block.reshape(-1), bad, self.first_toks])
         else:
-            block, self.tokens, self.pos, self.cache = self._fns[0](
-                self.params, self.cache, self.tokens, self.pos,
-                jnp.asarray(self.active), self.temps,
-                self._base_key, jnp.int32(self._tick))
+            block, self.tokens, self.pos, self.cache, bad = \
+                self._fns[0](
+                    self.params, self.cache, self.tokens, self.pos,
+                    jnp.asarray(self.active), self.temps,
+                    self._base_key, jnp.int32(self._tick))
+            self._inflight_spec = False
             self._inflight = jnp.concatenate(
-                [block.reshape(-1), self.first_toks])
+                [block.reshape(-1), bad, self.first_toks])
         self._tick += 1
 
     def step(self) -> list[_Request]:
@@ -1958,36 +2326,70 @@ class ContinuousBatcher:
         to owned-or-trash pages and whose tokens the budget clamp
         discards; admission is deferred to the next step, so a freshly
         freed slot is never re-filled under an in-flight stale tick."""
+        if self.dead is not None:
+            raise ReplicaDeadError(self.dead)
+        self._step_count += 1
+        t_tick = time.perf_counter()
         if (self.collect_overlap and self._inflight is not None
                 and not self.queue and not self._prefilling
                 and self.slot_req):
             prev, prev_spec_active = self._inflight, self._spec_active
-            self._dispatch_tick()          # tick N+1, before the sync
+            prev_spec = self._inflight_spec
+            try:
+                self._dispatch_with_retry()   # tick N+1, pre-sync
+            except ReplicaDeadError:
+                # the un-consumed tick N still holds real tokens —
+                # account it so the failover path never loses them
+                self._orphans.extend(
+                    self._consume(np.asarray(prev), prev_spec_active,
+                                  prev_spec) + self._failed)
+                self._failed.clear()
+                raise
             t0 = time.perf_counter()
             fused = np.asarray(prev)       # overlapped host readout
             dt = (time.perf_counter() - t0) * 1e3
             self.overlap_ms.append(dt)
             if self._metrics is not None:
                 self._metrics.observe("serve_collect_overlap_ms", dt)
-            return self._consume(fused, prev_spec_active)
+            finished = self._consume(fused, prev_spec_active, prev_spec)
+            if self._failed:
+                finished.extend(self._failed)
+                self._failed.clear()
+            self._watchdog(t_tick, finished)
+            return finished
         finished = self._collect()
-        t_adm = time.perf_counter()
-        self._tick_work = []
-        self._admit()
-        if self.paged:
-            self._run_prefill_chunks()
-        # per-tick decode stall: the admission + chunk work decode
-        # slots waited behind this tick (host wall — a lower bound
-        # under async dispatch; the bench anchors it on chained
-        # per-dispatch costs via _tick_log)
-        stall = (time.perf_counter() - t_adm) * 1e3
-        if self.slot_req:
-            self._dispatch_tick()
-            self.stall_ms.append(stall)
-            self._tick_log.append({"tick": self._tick - 1,
-                                   "work": self._tick_work})
-            if self._metrics is not None:
-                self._metrics.observe("serve_decode_stall_ms", stall)
+        try:
+            self._expire_deadlines(finished)
+            t_adm = time.perf_counter()
+            self._tick_work = []
+            self._admit()
+            if self.paged:
+                self._run_prefill_chunks()
+            # per-tick decode stall: the admission + chunk work decode
+            # slots waited behind this tick (host wall — a lower bound
+            # under async dispatch; the bench anchors it on chained
+            # per-dispatch costs via _tick_log)
+            stall = (time.perf_counter() - t_adm) * 1e3
+            if self.slot_req:
+                self._dispatch_with_retry()
+                self.stall_ms.append(stall)
+                self._tick_log.append({"tick": self._tick - 1,
+                                       "work": self._tick_work})
+                if self._metrics is not None:
+                    self._metrics.observe("serve_decode_stall_ms",
+                                          stall)
+        except ReplicaDeadError:
+            # requests that FINISHED this step must survive the death:
+            # stash them for the pool's failover harvest (exactly-once)
+            self._orphans.extend(finished + self._failed)
+            self._failed.clear()
+            raise
+        if self._failed:
+            finished.extend(self._failed)
+            self._failed.clear()
+        if self.debug_invariants:
+            self.check_page_invariants()
+        self._watchdog(t_tick, finished)
         return finished
 
     def _collect(self) -> list[_Request]:
@@ -1996,8 +2398,9 @@ class ContinuousBatcher:
             return []
         fused = np.asarray(self._inflight)    # THE host sync
         spec_active, self._spec_active = self._spec_active, None
+        spec = self._inflight_spec
         self._inflight = None
-        return self._consume(fused, spec_active)
+        return self._consume(fused, spec_active, spec)
 
     def _retire(self, slot: int, req: _Request,
                 finished: list[_Request]) -> None:
@@ -2013,22 +2416,27 @@ class ContinuousBatcher:
             self._gcap[slot] = self.spec_gamma
 
     def _consume(self, fused: np.ndarray,
-                 spec_active: np.ndarray | None) -> list[_Request]:
+                 spec_active: np.ndarray | None,
+                 spec: bool) -> list[_Request]:
         """Account one fetched fused block.  Non-spec layout:
-        ``[stride·B token block, B first tokens]``.  Spec layout:
-        ``[B·(γ+1) emit slab, B take, B matched, B first tokens]`` —
-        each slot consumed ``take+1`` real tokens (accepted drafts +
-        correction; the slab tail is filler), ``matched`` drives the
-        per-slot rolling acceptance and adaptive γ."""
+        ``[stride·B token block, B bad flags, B first tokens]``.  Spec
+        layout: ``[B·(γ+1) emit slab, B take, B matched, B bad flags,
+        B first tokens]`` — each slot consumed ``take+1`` real tokens
+        (accepted drafts + correction; the slab tail is filler),
+        ``matched`` drives the per-slot rolling acceptance and
+        adaptive γ.  ``spec`` is the layout of THIS fetch (a degraded
+        engine mixes spec and block ticks).  A slot whose bad flag is
+        set emitted non-finite logits: its tokens from this tick are
+        discarded and the slot is quarantined + replayed."""
         finished: list[_Request] = []
-        spec = bool(self.paged and self.spec_gamma)
         if spec:
             g, b = self.spec_gamma, self.n_slots
             nb = b * (g + 1)
             emit_np = fused[:nb].reshape(b, g + 1)
             take_np = fused[nb:nb + b]
             matched_np = fused[nb + b:nb + 2 * b]
-            firsts_np = fused[nb + 2 * b:]
+            bad_np = fused[nb + 2 * b:nb + 3 * b]
+            firsts_np = fused[nb + 3 * b:]
             self.slot_steps += (g + 1) * b
             self.spec_ticks += 1
             if spec_active is not None and spec_active.any():
@@ -2049,18 +2457,41 @@ class ContinuousBatcher:
                         self._metrics.observe(
                             "serve_spec_tokens_per_tick",
                             float(t_) + 1.0)
+                # acceptance-anomaly degradation: N consecutive verify
+                # ticks where NO active slot matched a single draft
+                # means the draft is paying compute for nothing (or
+                # worse, is corrupt) — fall back engine-wide to γ=0,
+                # which IS the decode-block path, bit for bit
+                if (self.spec_degrade_after is not None
+                        and not self.spec_degraded):
+                    if int(matched_np[act].sum()) == 0:
+                        self._spec_reject_streak += 1
+                    else:
+                        self._spec_reject_streak = 0
+                    if (self._spec_reject_streak
+                            >= self.spec_degrade_after):
+                        self.spec_degraded = True
+                        if self._metrics is not None:
+                            self._metrics.inc("serve_spec_degraded")
         else:
             nb = self.stride * self.n_slots
             block_np = fused[:nb].reshape(self.stride, self.n_slots)
-            firsts_np = fused[nb:]
+            bad_np = fused[nb:nb + self.n_slots]
+            firsts_np = fused[nb + self.n_slots:]
             self.slot_steps += self.stride * self.n_slots
         for slot, req in list(self.slot_req.items()):
             if slot in self._prefilling:
                 continue   # still chunk-prefilling: nothing emitted yet
-            if not req.tokens:   # first token materializes on fetch
+            if slot in self._await_first:
+                # first token materializes on fetch (prefill-produced,
+                # so it predates any poison in this decode tick)
                 req.tokens.append(int(firsts_np[slot]))
+                self._await_first.discard(slot)
             if req.done:   # single-token request: retires without decode
                 self._retire(slot, req, finished)
+                continue
+            if bad_np[slot]:
+                self._quarantine(slot, req)
                 continue
             want = req.max_new_tokens - len(req.tokens)
             if spec:
@@ -2100,13 +2531,89 @@ class ContinuousBatcher:
 
     def drain(self, max_ticks: int = 10_000) -> list[_Request]:
         """Run until queue and slots are empty; returns every finished
-        request in completion order."""
+        request in completion order.  Exhausting ``max_ticks`` with
+        work still in flight raises a DIAGNOSTIC error naming every
+        stuck slot/request (instead of silently returning with work
+        resident, which reads as 'lost requests' to the caller)."""
         out: list[_Request] = []
         for _ in range(max_ticks):
             if not self.queue and not self.slot_req:
                 return out
             out.extend(self.step())
-        raise RuntimeError("drain did not converge")
+        raise RuntimeError(
+            f"drain did not converge after {max_ticks} ticks; "
+            f"stuck work: {self._drain_diagnosis()}")
+
+    def _drain_diagnosis(self) -> str:
+        """Who is stuck and why — the payload drain() raises with."""
+        parts = []
+        for slot in sorted(self.slot_req):
+            req = self.slot_req[slot]
+            state = ("prefilling" if slot in self._prefilling
+                     else "active" if self.active[slot] else "inactive")
+            parts.append(
+                f"slot {slot}: rid={req.rid} {state} "
+                f"tokens={len(req.tokens)}/{req.max_new_tokens} "
+                f"retries={req.retries}")
+        for req, _ in self.queue:
+            parts.append(
+                f"queued rid={req.rid} admit_len={req.admit_len} "
+                f"not_before_tick={req.not_before_tick} "
+                f"(engine step {self._step_count})")
+        return "; ".join(parts) or "none visible (bookkeeping bug)"
+
+    def check_page_invariants(self) -> None:
+        """Page-leak detector (ISSUE 4 satellite; ``debug_invariants``
+        runs it every tick, the test suites call it directly): every
+        pool page must be exactly one of (a) free, (b) owned by a live
+        slot (refcount == owner count), or (c) prefix-cache-retained
+        at refcount 0 — and the three classes must partition
+        {1..total_pages} with trash page 0 in none of them.  Raises
+        RuntimeError on the first violation (explicit raises, not
+        asserts, so ``python -O`` keeps the detector armed)."""
+        if not self.paged:
+            return
+
+        def fail(msg: str) -> None:
+            raise RuntimeError(f"page invariant violated: {msg}")
+
+        allocated = set(self._page_refs)
+        if 0 in allocated or 0 in self._page_key:
+            fail("trash page 0 allocated or cached")
+        if set(self._free_pages) & allocated:
+            fail(f"pages both free and allocated: "
+                 f"{sorted(set(self._free_pages) & allocated)}")
+        universe = set(range(1, self.total_pages + 1))
+        if set(self._free_pages) | allocated != universe:
+            fail(f"leak/forgery: free∪allocated misses "
+                 f"{sorted(universe - set(self._free_pages) - allocated)}"
+                 f", extra "
+                 f"{sorted((set(self._free_pages) | allocated) - universe)}")
+        owners: dict[int, int] = {}
+        for slot, pages in self._slot_pages.items():
+            if len(pages) != len(set(pages)):
+                fail(f"slot {slot} references a page twice")
+            for p in pages:
+                owners[p] = owners.get(p, 0) + 1
+        for p in allocated:
+            if self._page_refs[p] != owners.get(p, 0):
+                fail(f"page {p}: refcount {self._page_refs[p]} != "
+                     f"{owners.get(p, 0)} owners")
+            if self._page_refs[p] == 0 and p not in self._page_key:
+                fail(f"page {p} unreferenced but not prefix-retained "
+                     "(leaked)")
+        for p, key in self._page_key.items():
+            if self._prefix_cache.get(key) != p:
+                fail(f"page {p} registry back-pointer broken")
+        for slot, pages in self._slot_pages.items():
+            row = self._pt[slot]
+            if list(row[:len(pages)]) != pages \
+                    or not (row[len(pages):] == 0).all():
+                fail(f"slot {slot} table row disagrees with its pages")
+        for slot in range(self.n_slots):
+            if slot not in self._slot_pages \
+                    and not (self._pt[slot] == 0).all():
+                fail(f"retired slot {slot} kept a live page table")
 
     @property
     def occupancy(self) -> float:
@@ -2136,6 +2643,21 @@ class ContinuousBatcher:
         return 1.0 + self.spec_drafts_accepted / ticks_slots
 
 
+@dataclass
+class _PoolEntry:
+    """Host-side durability record for one pool request: everything
+    needed to replay it on another replica after a fault."""
+    rid: int
+    prompt: np.ndarray
+    max_new: int
+    temperature: float
+    deadline: float | None
+    replica: int
+    local: int                    # engine-local rid on `replica`
+    prefix: list = field(default_factory=list)   # accepted tokens
+    retries: int = 0              # failover replays consumed
+
+
 class DataParallelServePool:
     """dp INDEPENDENT engine replicas behind ONE admission queue — the
     scale-out half of mesh-native serving.  Each replica is a full
@@ -2151,10 +2673,29 @@ class DataParallelServePool:
     would let one long request skew a whole replica's queue.  Prefix
     caching is PER-REPLICA (pools don't alias across meshes), so
     shared-prefix traffic benefits most when the router keeps it
-    together; the least-loaded policy is the throughput default."""
+    together; the least-loaded policy is the throughput default.
+
+    FAILOVER (ISSUE 4 tentpole): the pool keeps every request's prompt
+    and accepted tokens HOST-side, so when a replica dies mid-tick
+    (raises :class:`ReplicaDeadError` — a chaos kill, a watchdog
+    stall, or a control-plane eviction observed via
+    :meth:`observe_gang_eviction`/:meth:`watch_health`) the pool
+    harvests the dead engine's resident requests and re-admits each
+    survivor onto the least-loaded healthy replica as prompt +
+    accepted tokens with the remaining budget — greedy replay is
+    BIT-EXACT and prefix-cache-accelerated on the new replica.
+    Completion is idempotent: requests that finished in the dying step
+    are collected from the engine's orphan stash, never replayed.
+    Replays are bounded per request (``max_replays``); a request that
+    exceeds the bound — or whose ``deadline_s`` passes — surfaces as
+    FAILED (``error`` set, partial tokens preserved) instead of
+    wedging ``drain()``.  Metrics (when a registry is passed):
+    ``serve_failover_total``, ``serve_replay_ms``,
+    ``serve_requests_retried``."""
 
     def __init__(self, params: dict, cfg, dp: int = 1, tp: int = 1,
-                 devices=None, **engine_kw):
+                 devices=None, metrics=None, max_replays: int = 2,
+                 chaos=None, **engine_kw):
         devs = list(devices if devices is not None
                     else jax.devices()[:dp * tp])
         if len(devs) < dp * tp:
@@ -2162,17 +2703,32 @@ class DataParallelServePool:
                 f"dp={dp} x tp={tp} needs {dp * tp} devices, "
                 f"have {len(devs)}")
         engine_kw.setdefault("paged", True)
+        chaos = chaos or {}
         self.dp, self.tp = dp, tp
         self.replicas = [
             ContinuousBatcher(
                 params, cfg,
                 mesh=make_serve_mesh(tp, devs[i * tp:(i + 1) * tp]),
-                **engine_kw)
+                metrics=metrics, chaos=chaos.get(i), **engine_kw)
             for i in range(dp)
         ]
-        # rid namespacing: pool-level rid = replica * stride + local
-        self._rid_of: dict[tuple[int, int], int] = {}
+        self._metrics = metrics
+        self.max_replays = int(max_replays)
+        # host-side durability: pool rid → (prompt, budget, accepted
+        # prefix from prior incarnations, current placement)
+        self._entries: dict[int, _PoolEntry] = {}
+        self._local: dict[tuple[int, int], int] = {}  # (rep, lrid)→rid
         self._next_rid = 0
+        self.dead_replicas: dict[int, str] = {}
+        self.failovers = 0
+        self.replay_ms: list[float] = []
+        self.requests_retried = 0
+        # control-plane glue: serving gang → replica index, plus
+        # evictions observed (from a watch or an explicit call) that
+        # the next step() turns into failovers
+        self._gang_replica: dict[str, int] = {}
+        self._pending_deaths: list[tuple[int, str]] = []
+        self._unsub = None
 
     def warmup(self) -> None:
         for eng in self.replicas:
@@ -2181,31 +2737,227 @@ class DataParallelServePool:
     def _load(self, eng: ContinuousBatcher) -> int:
         return len(eng.queue) + len(eng.slot_req)
 
+    def _alive(self) -> list[int]:
+        return [i for i in range(self.dp) if i not in self.dead_replicas]
+
     def submit(self, prompt, max_new_tokens: int,
-               temperature: float = 0.0) -> int:
-        i = min(range(self.dp), key=lambda j: self._load(self.replicas[j]))
+               temperature: float = 0.0,
+               deadline_s: float | None = None) -> int:
+        alive = self._alive()
+        if not alive:
+            raise ReplicaDeadError(
+                "no healthy replicas left: "
+                + "; ".join(f"replica {i}: {r}"
+                            for i, r in self.dead_replicas.items()))
+        i = min(alive, key=lambda j: self._load(self.replicas[j]))
         local = self.replicas[i].submit(prompt, max_new_tokens,
                                         temperature)
         rid = self._next_rid
         self._next_rid += 1
-        self._rid_of[(i, local)] = rid
+        self._entries[rid] = _PoolEntry(
+            rid=rid, prompt=np.asarray(prompt, np.int32),
+            max_new=max_new_tokens, temperature=float(temperature),
+            deadline=(time.monotonic() + deadline_s
+                      if deadline_s is not None else None),
+            replica=i, local=local)
+        self._local[(i, local)] = rid
         return rid
 
-    def step(self) -> list[_Request]:
-        done = []
-        for i, eng in enumerate(self.replicas):
-            for r in eng.step():
-                r.rid = self._rid_of.pop((i, r.rid))
+    # -- control-plane integration ------------------------------------
+
+    def bind_replica_gang(self, replica: int, gang: str) -> None:
+        """Declare that ``replica`` is backed by serving gang ``gang``
+        — the link the health controller's evictions resolve through."""
+        self._gang_replica[gang] = replica
+
+    def observe_gang_eviction(self, gang: str,
+                              reason: str = "gang evicted") -> None:
+        """A serving gang died in the control plane (the health
+        controller evicted it).  The bound replica is marked for death;
+        the next step() fails its requests over to healthy replicas."""
+        i = self._gang_replica.get(gang)
+        if i is not None and i not in self.dead_replicas:
+            self._pending_deaths.append((i, f"{reason} (gang {gang})"))
+
+    def watch_health(self, api) -> None:
+        """Subscribe to the apiserver watch stream: a DELETED pod of a
+        bound serving gang (the eviction's delete-and-recreate) marks
+        that replica dead — the same event flow training recovery
+        rides, now driving serving failover."""
+        from kubegpu_tpu.kubemeta.codec import pod_gang_spec
+
+        def _cb(ev) -> None:
+            if ev.kind != "Pod" or ev.type != "DELETED":
+                return
+            gs = pod_gang_spec(ev.obj)
+            if gs is not None and gs.name in self._gang_replica:
+                self.observe_gang_eviction(gs.name, "pod evicted")
+
+        self._unsub = api.watch(_cb)
+
+    def close(self) -> None:
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
+
+    # -- failover -----------------------------------------------------
+
+    def _fail_entry(self, e: "_PoolEntry", why: str,
+                    done: list) -> None:
+        r = _Request(rid=e.rid, prompt_len=int(e.prompt.shape[0]),
+                     max_new_tokens=e.max_new,
+                     temperature=e.temperature, prompt=e.prompt)
+        r.tokens = list(e.prefix)
+        r.done = True
+        r.error = why
+        self._entries.pop(e.rid, None)
+        done.append(r)
+
+    def _finish(self, replica: int, r: _Request, done: list) -> None:
+        rid = self._local.pop((replica, r.rid), None)
+        if rid is None:
+            return   # idempotence: already completed/failed over
+        e = self._entries.pop(rid, None)
+        if e is not None and e.prefix:
+            r.tokens = e.prefix + r.tokens
+        r.rid = rid
+        done.append(r)
+
+    def _failover(self, i: int, reason: str, done: list) -> None:
+        """Re-admit every request resident on dead replica ``i`` onto
+        healthy replicas via bit-exact greedy replay (prompt +
+        accepted tokens, remaining budget)."""
+        self.dead_replicas[i] = reason
+        self.failovers += 1
+        if self._metrics is not None:
+            self._metrics.inc("serve_failover_total")
+        t0 = time.perf_counter()
+        eng = self.replicas[i]
+        # completed-but-unreturned finishers first (exactly-once)
+        for r in eng.take_orphans():
+            self._finish(i, r, done)
+        resident: dict[int, _Request] = {}
+        for req in list(eng.slot_req.values()) \
+                + [r for r, _ in eng.queue]:
+            resident[req.rid] = req
+        alive = self._alive()
+        n_replayed = 0
+        for local in sorted(resident):
+            req = resident[local]
+            rid = self._local.pop((i, local), None)
+            if rid is None:
+                continue
+            e = self._entries[rid]
+            e.prefix = e.prefix + list(req.tokens)
+            remaining = e.max_new - len(e.prefix)
+            if remaining < 1:    # finished exactly at the fault
+                r = _Request(rid=rid, prompt_len=int(e.prompt.shape[0]),
+                             max_new_tokens=e.max_new,
+                             temperature=e.temperature, prompt=e.prompt)
+                r.tokens = list(e.prefix)
+                r.done = True
+                self._entries.pop(rid, None)
                 done.append(r)
+                continue
+            e.retries += 1
+            if e.retries > self.max_replays:
+                self._fail_entry(
+                    e, f"exceeded {self.max_replays} failovers "
+                    f"(last: {reason})", done)
+                continue
+            if not alive:
+                self._fail_entry(
+                    e, f"no healthy replicas left ({reason})", done)
+                continue
+            replay = (np.concatenate(
+                [e.prompt, np.asarray(e.prefix, np.int32)])
+                if e.prefix else e.prompt)
+            j = min(alive, key=lambda k: self._load(self.replicas[k]))
+            try:
+                new_local = self.replicas[j].submit(
+                    replay, remaining, e.temperature)
+            except ValueError as err:
+                self._fail_entry(e, f"replay rejected: {err}", done)
+                continue
+            e.replica, e.local = j, new_local
+            self._local[(j, new_local)] = rid
+            n_replayed += 1
+            self.requests_retried += 1
+            if self._metrics is not None:
+                self._metrics.inc("serve_requests_retried")
+        dt = (time.perf_counter() - t0) * 1e3
+        if n_replayed or resident:
+            self.replay_ms.append(dt)
+            if self._metrics is not None:
+                self._metrics.observe("serve_replay_ms", dt)
+
+    def _expire_deadlines(self, done: list) -> None:
+        if not any(e.deadline is not None
+                   for e in self._entries.values()):
+            return
+        now = time.monotonic()
+        for e in list(self._entries.values()):
+            if e.deadline is None or now <= e.deadline:
+                continue
+            eng = self.replicas[e.replica]
+            partial = None
+            if e.replica not in self.dead_replicas:
+                partial = eng.cancel(e.local, "deadline exceeded")
+            self._local.pop((e.replica, e.local), None)
+            if partial is not None and partial.tokens:
+                e.prefix = e.prefix + list(partial.tokens)
+            self._fail_entry(e, "deadline exceeded", done)
+
+    def cancel(self, rid: int, reason: str = "canceled"):
+        """Cancel a pool request wherever it lives; returns the failed
+        request (partial tokens preserved) or None if unknown."""
+        e = self._entries.get(rid)
+        if e is None:
+            return None
+        if e.replica not in self.dead_replicas:
+            partial = self.replicas[e.replica].cancel(e.local, reason)
+            if partial is not None and partial.tokens:
+                e.prefix = e.prefix + list(partial.tokens)
+        self._local.pop((e.replica, e.local), None)
+        sink: list = []
+        self._fail_entry(e, reason, sink)
+        return sink[0]
+
+    def step(self) -> list[_Request]:
+        done: list[_Request] = []
+        while self._pending_deaths:
+            i, reason = self._pending_deaths.pop(0)
+            if i in self.dead_replicas:
+                continue
+            self.replicas[i].dead = reason   # engine refuses new work
+            self._failover(i, reason, done)
+        self._expire_deadlines(done)
+        for i, eng in enumerate(self.replicas):
+            if i in self.dead_replicas:
+                continue
+            try:
+                rs = eng.step()
+            except ReplicaDeadError as e:
+                self._failover(i, str(e), done)
+                continue
+            for r in rs:
+                self._finish(i, r, done)
         return done
 
     def drain(self, max_ticks: int = 10_000) -> list[_Request]:
         out: list[_Request] = []
         for _ in range(max_ticks):
-            if not any(e.queue or e.slot_req for e in self.replicas):
+            if not self._entries and not self._pending_deaths:
                 return out
             out.extend(self.step())
-        raise RuntimeError("drain did not converge")
+        diag = "; ".join(
+            f"replica {e.replica}{' (DEAD)' if e.replica in self.dead_replicas else ''}: "
+            f"rid={rid} prefix={len(e.prefix)}/{e.max_new} "
+            f"retries={e.retries}"
+            for rid, e in sorted(self._entries.items()))
+        raise RuntimeError(
+            f"drain did not converge after {max_ticks} ticks; "
+            f"stuck work: {diag or 'none visible (bookkeeping bug)'}")
 
     @property
     def emitted_tokens(self) -> int:
@@ -2230,6 +2982,22 @@ class DataParallelServePool:
     @property
     def stall_ms(self) -> list[float]:
         return [s for e in self.replicas for s in e.stall_ms]
+
+    # robustness aggregates (the serve pod's failover metric echo)
+    @property
+    def slots_quarantined(self) -> int:
+        return sum(e.slots_quarantined for e in self.replicas)
+
+    @property
+    def dispatch_failures(self) -> int:
+        return sum(e.dispatch_failures for e in self.replicas)
+
+    @property
+    def requests_retried_total(self) -> int:
+        """Pool-level failover replays + engine-level quarantine
+        replays, combined."""
+        return self.requests_retried + sum(
+            e.requests_retried for e in self.replicas)
 
     @property
     def spec_acceptance_rate(self) -> float:

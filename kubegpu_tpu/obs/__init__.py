@@ -6,10 +6,19 @@ histogram → p50, allocation locality gauge) and a structured per-decision
 schedule trace (why each slice scored what).
 """
 
+from kubegpu_tpu.obs.chaos import (
+    ChaosEvent,
+    ChaosInjector,
+    DispatchFailure,
+    ReplicaDeadError,
+    TickStallError,
+)
 from kubegpu_tpu.obs.logging import configure as configure_logging
 from kubegpu_tpu.obs.logging import get_logger
 from kubegpu_tpu.obs.metrics import MetricsRegistry, global_registry
 from kubegpu_tpu.obs.trace import ScheduleTrace, TraceEvent
 
 __all__ = ["MetricsRegistry", "global_registry", "ScheduleTrace",
-           "TraceEvent", "get_logger", "configure_logging"]
+           "TraceEvent", "get_logger", "configure_logging",
+           "ChaosEvent", "ChaosInjector", "DispatchFailure",
+           "ReplicaDeadError", "TickStallError"]

@@ -1,0 +1,124 @@
+"""Deterministic fault injection for the serving stack (ISSUE 4).
+
+The training side already treats hardware loss as routine (the health
+controller evicts and re-places whole gangs); this module gives the
+SERVING stack the same discipline by making failures reproducible: a
+:class:`ChaosInjector` is a seeded schedule of :class:`ChaosEvent`\\ s
+that an engine consults at every tick boundary.  Four fault kinds cover
+the failure modes production TPU serving actually sees:
+
+- ``kill_replica`` — the whole engine dies mid-tick (host preemption,
+  slice revocation).  The engine raises :class:`ReplicaDeadError`;
+  :class:`~kubegpu_tpu.models.serve.DataParallelServePool` catches it
+  and re-admits every resident request onto healthy replicas via
+  prefix-cache-accelerated replay.
+- ``fail_dispatch`` — ONE dispatch fails transiently
+  (:class:`DispatchFailure`); the engine retries it in place (the
+  dispatch is functional, so a retry re-runs identical math) and only
+  escalates to replica death after repeated failures.
+- ``nan_logits`` — a slot's pool pages are poisoned with NaN, so that
+  slot's logits go non-finite while its neighbors stay exact (slots
+  are independent batch rows).  The engine's per-tick invalid-logit
+  detector quarantines the slot and replays its request instead of
+  letting the poison ride the batch.
+- ``stall_tick`` — the tick sleeps past the engine's watchdog deadline
+  (``tick_deadline_s``); the watchdog declares the replica stalled
+  (:class:`TickStallError`, a :class:`ReplicaDeadError`) and the pool
+  fails over exactly as for a kill.
+
+Determinism contract: an injector is a pure function of its events (or
+of ``from_seed``'s arguments), and every downstream recovery action is
+greedy-replay bit-exact — so a chaos run must emit EXACTLY the
+fault-free run's tokens, which is what ``tests/test_serve_chaos.py``
+and the ``cb_chaos`` bench row assert.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class ChaosError(RuntimeError):
+    """Base class for injected serving faults."""
+
+
+class ReplicaDeadError(ChaosError):
+    """The engine is dead (killed, or declared dead by its watchdog);
+    every subsequent ``step()`` re-raises.  The pool's failover path
+    catches this, harvests the engine's host-side request state, and
+    replays survivors on healthy replicas."""
+
+
+class TickStallError(ReplicaDeadError):
+    """Watchdog verdict: a tick exceeded ``tick_deadline_s``.  A
+    subclass of :class:`ReplicaDeadError` because the recovery policy
+    is identical — a replica that can stall once can wedge ``drain()``
+    forever, so the pool fails over rather than waiting."""
+
+
+class DispatchFailure(ChaosError):
+    """A single dispatch failed transiently; the engine retries the
+    same dispatch (safe: dispatches are functional) with a bounded
+    budget before escalating to replica death."""
+
+
+KILL = "kill_replica"
+FAIL_DISPATCH = "fail_dispatch"
+NAN_LOGITS = "nan_logits"
+STALL = "stall_tick"
+KINDS = (KILL, FAIL_DISPATCH, NAN_LOGITS, STALL)
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    tick: int            # engine tick (dispatch counter) to fire at
+    kind: str            # one of KINDS
+    stall_s: float = 0.0  # sleep injected for STALL events
+
+
+@dataclass
+class ChaosInjector:
+    """Seeded, replayable fault schedule for ONE engine.
+
+    ``take(tick)`` pops every event due at or before ``tick`` (events
+    fire once); ``defer(ev, tick)`` re-queues an event the engine could
+    not apply yet (e.g. a NaN injection with no eligible slot).  The
+    ``fired`` log is the audit trail the bench row reports."""
+
+    events: list = field(default_factory=list)
+    fired: list = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        for ev in self.events:
+            if ev.kind not in KINDS:
+                raise ValueError(f"unknown chaos kind {ev.kind!r}")
+        self.events = sorted(self.events, key=lambda e: e.tick)
+
+    @classmethod
+    def from_seed(cls, seed: int, ticks: int,
+                  kinds: tuple = KINDS,
+                  n_events: int = 1,
+                  stall_s: float = 0.0) -> "ChaosInjector":
+        """Draw ``n_events`` events uniformly over ``[1, ticks]`` from a
+        seeded generator — the scenario-matrix entry point (same seed ⇒
+        same schedule ⇒ same recovery sequence)."""
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        evs = [ChaosEvent(tick=int(rng.integers(1, max(ticks, 2))),
+                          kind=str(rng.choice(list(kinds))),
+                          stall_s=stall_s)
+               for _ in range(n_events)]
+        return cls(events=evs)
+
+    def take(self, tick: int) -> list:
+        due = [e for e in self.events if e.tick <= tick]
+        if due:
+            self.events = [e for e in self.events if e.tick > tick]
+            self.fired.extend(due)
+        return due
+
+    def defer(self, ev: ChaosEvent, tick: int) -> None:
+        self.fired.remove(ev)
+        self.events.append(ChaosEvent(tick=tick, kind=ev.kind,
+                                      stall_s=ev.stall_s))
+        self.events.sort(key=lambda e: e.tick)

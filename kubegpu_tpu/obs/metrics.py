@@ -11,6 +11,38 @@ draft match fraction of the speculative engine), ``serve_spec_tokens_
 per_tick`` (tokens banked per slot per verify tick — accepted drafts +
 correction), and ``serve_collect_overlap_ms`` (host readout wall hidden
 behind the double-buffered next tick when ``collect_overlap`` is on).
+
+Serving fault-tolerance metrics (ISSUE 4 — observed by the engine and
+``DataParallelServePool`` when a registry is passed; the serve pod
+echoes the same names so ``DeviceScheduler.serving_metrics()`` carries
+them as scheduler-visible gauges):
+
+===========================  ==========  ================================
+name                         kind        meaning
+===========================  ==========  ================================
+``serve_failover_total``     counter     dp replicas declared dead and
+                                         failed over (kill, watchdog
+                                         stall, or control-plane gang
+                                         eviction)
+``serve_replay_ms``          histogram   wall time of one failover's
+                                         re-admission sweep (harvest +
+                                         replay submits)
+``serve_requests_retried``   counter     requests re-admitted via
+                                         bit-exact replay (engine
+                                         quarantine + pool failover)
+``serve_slots_quarantined``  counter     slots pulled from the batch on
+                                         non-finite logits
+``serve_requests_shed``      counter     admissions failed by
+                                         backpressure instead of
+                                         deadlocking the queue
+``serve_dispatch_failures``  counter     transient dispatch failures
+                                         retried in place
+``serve_tick_stalls``        counter     watchdog deadline trips
+``serve_replica_deaths``     counter     engine deaths (any cause)
+``serve_spec_degraded``      counter     engines that fell back to γ=0
+                                         on repeated zero-acceptance
+                                         verify ticks
+===========================  ==========  ================================
 """
 
 from __future__ import annotations

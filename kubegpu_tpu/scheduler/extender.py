@@ -89,7 +89,8 @@ class DeviceScheduler:
                  trace: ScheduleTrace | None = None,
                  coordinator_port: int = 8476,
                  gang_grace_s: float = 30.0,
-                 max_planning_victims: int = 16):
+                 max_planning_victims: int = 16,
+                 bind_retries: int = 3):
         self.api = api
         self.allocator = allocator or GangAllocator()
         self.metrics = metrics or MetricsRegistry()
@@ -105,6 +106,16 @@ class DeviceScheduler:
         # find_assignment) before declaring the request unplaceable —
         # bounds the p99 tail of failing decisions (VERDICT r1 #3).
         self.max_planning_victims = max_planning_victims
+        # Bounded retry budget for apiserver write CONFLICTS on the
+        # bind path (a lost optimistic-concurrency race with another
+        # writer bumping the pod's resourceVersion).  Today's behavior
+        # without it: the race surfaces as a hard bind failure and the
+        # whole decision is thrown away.  Retries back off with
+        # jitter; exhaustion requeues (the extender verb returns an
+        # error so kube-scheduler's retry loop re-runs the pod).
+        self.bind_retries = bind_retries
+        import random as _random
+        self._bind_rng = _random.Random(0x5eed)
         self.slices: dict[str, SliceState] = {}
         self._committed: dict[str, GangAssignment] = {}  # gang → assignment
         self._pod_gang: dict[str, str] = {}              # pod name → gang
@@ -162,7 +173,14 @@ class DeviceScheduler:
         surface (GET /metrics) carries it as a first-class scheduler
         signal: a slice whose pods accept ~0 is paying draft compute
         for nothing, which is a placement/config smell the operator
-        should see next to schedule latency, not buried in pod logs."""
+        should see next to schedule latency, not buried in pod logs.
+
+        Fault-tolerance gauges ride the same harvest (ISSUE 4): the
+        serve pod echoes ``serve_failover_total`` / ``serve_requests_
+        retried`` / ``serve_slots_quarantined``, mirrored here into
+        ``serving_failover_total`` etc. — a slice whose serving pods
+        fail over repeatedly is a health signal the scheduler should
+        surface next to gang evictions, not bury in pod stdout."""
         with self._lock:
             snap = self.metrics.snapshot()["gauges"]
         out = {k[len("workload_"):]: v for k, v in snap.items()
@@ -170,7 +188,33 @@ class DeviceScheduler:
         acc = out.get("serve_engine_spec_accept_rate")
         if acc is not None:
             self.metrics.set_gauge("serving_spec_acceptance", acc)
+        for src, dst in (
+                ("serve_failover_total", "serving_failover_total"),
+                ("serve_requests_retried", "serving_requests_retried"),
+                ("serve_slots_quarantined",
+                 "serving_slots_quarantined")):
+            v = out.get(src)
+            if v is not None:
+                self.metrics.set_gauge(dst, v)
         return out
+
+    def _write_retrying(self, fn, *args, **kw):
+        """Run one apiserver write, retrying resourceVersion conflicts
+        with jittered exponential backoff (``bind_retries`` attempts).
+        The final attempt propagates — callers map the surviving
+        Conflict to their own requeue semantics (the wire verb returns
+        an error string; run_once lets the daemon's control-plane
+        retry loop absorb it)."""
+        from kubegpu_tpu.kubemeta.controlplane import Conflict
+        delay = 0.002
+        for _ in range(max(0, self.bind_retries)):
+            try:
+                return fn(*args, **kw)
+            except Conflict:
+                self.metrics.inc("bind_conflict_retries")
+                time.sleep(delay * (0.5 + self._bind_rng.random()))
+                delay = min(delay * 2, 0.05)
+        return fn(*args, **kw)
 
     # ------------------------------------------------------------------
     # Identity: in-memory gang/pod keys are NAMESPACE-QUALIFIED so two
@@ -404,8 +448,26 @@ class DeviceScheduler:
         the chosen node.  Gang members consume the hold-and-assume
         decision made at /filter time (see :meth:`_wire_assume`); chips
         were committed then, so this only writes annotations + binding.
+
+        Apiserver write CONFLICTS (a lost resourceVersion race) are
+        retried ``bind_retries`` times with jittered backoff; if one
+        survives anyway the verb returns an error — kube-scheduler's
+        retry loop requeues the pod, and the next attempt re-reads
+        fresh state.
         """
+        from kubegpu_tpu.kubemeta.controlplane import Conflict
         with self._lock:
+            try:
+                return self._bind_locked(pod_name, node_name, namespace)
+            except Conflict as e:
+                self.metrics.inc("bind_conflict_requeued")
+                return (f"bind conflict persisted after "
+                        f"{self.bind_retries} retries; pod requeued "
+                        f"for re-scheduling: {e}")
+
+    def _bind_locked(self, pod_name: str, node_name: str,
+                     namespace: str) -> str | None:
+        if True:
             t0 = time.perf_counter()
             self._wire_expire()
             from kubegpu_tpu.kubemeta import NotFound
@@ -420,7 +482,8 @@ class DeviceScheduler:
                 if alloc.node_name != node_name:
                     return (f"pod already allocated on {alloc.node_name}, "
                             f"refusing bind to {node_name}")
-                self.api.bind_pod(pod_name, node_name, namespace=namespace)
+                self._write_retrying(self.api.bind_pod, pod_name,
+                                     node_name, namespace=namespace)
                 # a gang member retried here still counts toward its
                 # assumption's completion — otherwise the assumption
                 # never fulfills and expiry frees chips this pod OWNS
@@ -449,7 +512,8 @@ class DeviceScheduler:
             return quota_reason
         gkey = self._gkey(ns, pod.name)
         if req.total_chips == 0 and req.millitpu_per_pod == 0:
-            self.api.bind_pod(pod.name, node_name, namespace=ns)
+            self._write_retrying(self.api.bind_pod, pod.name, node_name,
+                                 namespace=ns)
             self._observe_latency(t0, gkey, scheduled=True)
             return None
         st = self._slice_of_node(node_name)
@@ -468,12 +532,13 @@ class DeviceScheduler:
         self._gang_priority[gkey] = pod.spec.priority
         self._gang_migratable[gkey] = pod_migratable(pod)
         self._pod_gang[gkey] = gkey
-        self.api.patch_annotations(
-            "Pod", pod.name,
+        self._write_retrying(
+            self.api.patch_annotations, "Pod", pod.name,
             {ALLOCATE_FROM_KEY: allocation_to_annotation(allocations[0]),
              MIGRATION_DEBT_KEY: None},   # repaid via the wire path too
             namespace=ns)
-        self.api.bind_pod(pod.name, node_name, namespace=ns)
+        self._write_retrying(self.api.bind_pod, pod.name, node_name,
+                             namespace=ns)
         self.metrics.observe("allocation_locality", asg.locality)
         self._observe_latency(t0, gkey, scheduled=True)
         self.trace.record("bind", gang=gkey, detail={
@@ -495,12 +560,13 @@ class DeviceScheduler:
         if node != node_name:
             return (f"gang member is assigned to {node}, refusing bind "
                     f"to {node_name}")
-        self.api.patch_annotations(
-            "Pod", pod.name,
+        self._write_retrying(
+            self.api.patch_annotations, "Pod", pod.name,
             {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc),
              MIGRATION_DEBT_KEY: None},   # repaid via the wire path too
             namespace=ns)
-        self.api.bind_pod(pod.name, node_name, namespace=ns)
+        self._write_retrying(self.api.bind_pod, pod.name, node_name,
+                             namespace=ns)
         self._wire_note_bound(gkey, pod.name, t0)
         return None
 
@@ -992,8 +1058,9 @@ class DeviceScheduler:
                 return
             target = min(nodes, key=lambda n: n.name)
             for pod in members:
-                self.api.bind_pod(pod.name, target.name,
-                                  namespace=pod.metadata.namespace)
+                self._write_retrying(self.api.bind_pod, pod.name,
+                                     target.name,
+                                     namespace=pod.metadata.namespace)
                 result.scheduled.append(pod.name)
             self._observe_latency(t0, gang_name, scheduled=True)
             return
@@ -1095,8 +1162,9 @@ class DeviceScheduler:
                  # debt repaid: drop the persisted home reservation
                  MIGRATION_DEBT_KEY: None},
                 namespace=pod.metadata.namespace)
-            self.api.bind_pod(pod.name, alloc.node_name,
-                              namespace=pod.metadata.namespace)
+            self._write_retrying(self.api.bind_pod, pod.name,
+                                  alloc.node_name,
+                                  namespace=pod.metadata.namespace)
             result.scheduled.append(pod.name)
         self.metrics.set_gauge("last_allocation_locality", asg.locality)
         self.metrics.observe("allocation_locality", asg.locality)

@@ -333,7 +333,21 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                 ("serve_engine_stall_p50_ms",
                  round(stall["p50"], 3)),
                 ("serve_engine_stall_p99_ms",
-                 round(stall["p99"], 3))):
+                 round(stall["p99"], 3)),
+                # fault-tolerance echo (ISSUE 4): zeros on a healthy
+                # run, but harvested unconditionally so the
+                # scheduler's serving_metrics() surface carries the
+                # failover story per pod (a slice whose serving pods
+                # fail over is a health signal, not pod-log noise)
+                ("serve_failover_total",
+                 getattr(eng, "failovers", 0)),
+                ("serve_requests_retried",
+                 getattr(eng, "requests_retried_total",
+                         eng.requests_retried)),
+                ("serve_slots_quarantined", eng.slots_quarantined),
+                ("serve_requests_shed",
+                 eng.requests_shed if hasattr(eng, "requests_shed")
+                 else sum(e.requests_shed for e in eng.replicas))):
             print(json.dumps({"metric": name, "value": value}))
     if not ok:
         print("FAIL: continuous engine dropped or corrupted requests",

@@ -68,6 +68,36 @@ def test_serving_bench_smoke_parses_and_carries_keys():
     # acceptance is deterministic on the fixed-seed trained model, so
     # the smoke asserts the STRUCTURAL wins (bit parity, >= 0.5
     # acceptance, fewer dispatches for the same tokens), not timings.
+    # chaos-hardened serving (ISSUE 4): the seeded fault matrix
+    # (replica kill, dispatch failure, NaN poisoning, tick stall) must
+    # complete every request EXACTLY once with tokens bit-exact vs the
+    # fault-free run, and the row must carry the failover/replay
+    # timings the driver's acceptance gate reads.  Under the 8-device
+    # window the dp scenarios run for real, not as skip rows.
+    ch = doc["cb_chaos"]
+    assert ch["protocol"] == "seeded_chaos_matrix"
+    assert ch["fault_free"]["lost"] == 0
+    assert ch["fault_free"]["duplicated"] == 0
+    assert ch["all_bit_exact"] is True
+    assert ch["total_lost"] == 0 and ch["total_duplicated"] == 0
+    needed = ["dispatch_failure", "nan_logits"]
+    if len(jax.devices()) >= 2:
+        needed += ["replica_kill", "tick_stall"]
+    for name in needed:
+        row = ch["scenarios"][name]
+        assert "skipped" not in row, (name, row)
+        assert row["completed"] == ch["requests"], (name, row)
+        assert row["lost"] == 0 and row["duplicated"] == 0, (name, row)
+        assert row["bit_exact_vs_fault_free"] is True, name
+    assert ch["scenarios"]["dispatch_failure"]["dispatch_failures"] >= 1
+    assert ch["scenarios"]["nan_logits"]["slots_quarantined"] >= 1
+    assert ch["scenarios"]["nan_logits"]["requests_retried"] >= 1
+    if len(jax.devices()) >= 2:
+        for name in ("replica_kill", "tick_stall"):
+            row = ch["scenarios"][name]
+            assert row["failovers"] >= 1, name
+            assert row["replay_ms"]["count"] >= 1, name
+
     sp = doc["cb_spec"]
     assert sp["draft_layers"] == 2 and sp["gammas"] == [3]
     degrees = ["tp1", "tp2"] if len(jax.devices()) >= 2 else ["tp1"]

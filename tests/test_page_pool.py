@@ -23,6 +23,11 @@ def tiny():
 
 
 def make_engine(cfg, params, total_pages=None, n_slots=3, **kw):
+    # debug_invariants arms the ENGINE's own page-leak detector
+    # (ISSUE 4 satellite) on every tick of every fuzz/property run in
+    # this file — the in-tree invariant checks below and the engine's
+    # self-check must agree at all times
+    kw.setdefault("debug_invariants", True)
     return ContinuousBatcher(
         params, cfg, n_slots=n_slots, max_len=32, stride=2,
         prompt_buckets=(8, 16), paged=True, page_size=8,
@@ -93,6 +98,49 @@ def check_refcount_invariants(eng):
     for slot in range(eng.n_slots):
         if slot not in eng._slot_pages:
             assert (eng._pt[slot] == 0).all()
+
+
+class TestLeakDetector:
+    """The engine's own ``check_page_invariants`` (debug flag + test
+    helper): silent on a healthy pool, loud on fabricated corruption —
+    so the fuzz suites' every-tick self-checks actually have teeth."""
+
+    def test_healthy_pool_passes(self, tiny):
+        cfg, params = tiny
+        eng = make_engine(cfg, params)
+        eng.check_page_invariants()
+        eng.submit(np.arange(1, 6), 4)
+        eng.step()
+        eng.check_page_invariants()
+        eng.drain()
+        eng.check_page_invariants()
+
+    def test_detects_leaked_page(self, tiny):
+        cfg, params = tiny
+        eng = make_engine(cfg, params)
+        eng._free_pages.pop()            # fabricate a leak
+        with pytest.raises(RuntimeError, match="leak"):
+            eng.check_page_invariants()
+
+    def test_detects_refcount_drift(self, tiny):
+        cfg, params = tiny
+        eng = make_engine(cfg, params, debug_invariants=False)
+        eng.submit(np.arange(1, 6), 4)
+        eng.step()
+        page = next(iter(eng._slot_pages.values()))[0]
+        eng._page_refs[page] += 1        # fabricate an over-count
+        with pytest.raises(RuntimeError, match="refcount"):
+            eng.check_page_invariants()
+
+    def test_detects_table_row_drift(self, tiny):
+        cfg, params = tiny
+        eng = make_engine(cfg, params, debug_invariants=False)
+        eng.submit(np.arange(1, 6), 4)
+        eng.step()
+        slot = next(iter(eng._slot_pages))
+        eng._pt[slot, 0] = 0             # fabricate a zeroed table slot
+        with pytest.raises(RuntimeError, match="table row"):
+            eng.check_page_invariants()
 
 
 class TestPagePoolFuzz:

@@ -493,6 +493,12 @@ class TestServingTrafficModel:
             ("serve_engine_cfg_draft_layers", 8),
             ("serve_engine_spec_accept_rate", 0.625),
             ("serve_engine_spec_tokens_per_tick", 3.5),
+            # fault-tolerance echo (ISSUE 4): a serving pod that
+            # failed over reports it; the scheduler mirrors it onto
+            # the scrape surface next to gang evictions
+            ("serve_failover_total", 2),
+            ("serve_requests_retried", 3),
+            ("serve_slots_quarantined", 1),
         ))
         seen = harvest_workload_metrics(stdout, cl.metrics, "serve-0")
         assert "serve_engine_spec_accept_rate" in seen
@@ -501,4 +507,8 @@ class TestServingTrafficModel:
         assert out["serve_engine_cfg_spec_gamma"] == 4
         assert out["serve_engine_spec_tokens_per_tick"] == 3.5
         assert cl.metrics.gauge("serving_spec_acceptance") == 0.625
+        assert out["serve_failover_total"] == 2
+        assert cl.metrics.gauge("serving_failover_total") == 2
+        assert cl.metrics.gauge("serving_requests_retried") == 3
+        assert cl.metrics.gauge("serving_slots_quarantined") == 1
         cl.close()

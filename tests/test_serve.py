@@ -164,6 +164,31 @@ class TestContinuousBatcher:
         assert done[0].rid == rid
         assert done[0].tokens == solo(params, p, 1, cfg)
 
+    def test_dense_engine_nan_quarantine(self, tiny):
+        """Fault tolerance is NOT page-pool-only: the dense slot-cache
+        engine detects a poisoned row's non-finite logits, quarantines
+        the slot, and replays the request bit-exactly (ISSUE 4 — the
+        chaos suite covers the paged engine; this pins the dense
+        path)."""
+        from kubegpu_tpu.obs.chaos import ChaosEvent, ChaosInjector
+        cfg, params = tiny
+        eng = ContinuousBatcher(
+            params, cfg, n_slots=2, stride=4, prompt_buckets=(8, 16),
+            chaos=ChaosInjector(
+                [ChaosEvent(tick=1, kind="nan_logits")]))
+        prompts = [([(i * 3 + 1) % cfg.vocab_size for i in range(5)], 8),
+                   ([(i * 5 + 2) % cfg.vocab_size for i in range(7)], 8)]
+        rids = {eng.submit(p, n): (p, n) for p, n in prompts}
+        seen = {}
+        for r in eng.drain():
+            assert r.rid not in seen
+            seen[r.rid] = r
+        assert set(seen) == set(rids)
+        assert eng.slots_quarantined == 1
+        for rid, (p, n) in rids.items():
+            assert seen[rid].error is None
+            assert seen[rid].tokens == solo(params, p, n, cfg), rid
+
 
 class TestPagedBatcher:
     """Paged-pool engine (ops/paged_attention.py): same external

@@ -1,0 +1,437 @@
+"""Chaos-hardened serving (ISSUE 4): seeded fault injection against
+the paged continuous-batching engine and the dp pool — replica kill,
+transient dispatch failure, NaN-logit poisoning, watchdog tick stalls,
+deadlines, cancellation, and control-plane-driven failover.
+
+The recovery contract under EVERY scenario: no admitted request is
+lost, none completes twice, and every replayed greedy stream is
+token-for-token identical to the fault-free run (replay re-conditions
+on the accepted prefix, so greedy argmax continues identically)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubegpu_tpu.models import LlamaConfig, greedy_generate, llama_init
+from kubegpu_tpu.models.serve import ContinuousBatcher, DataParallelServePool
+from kubegpu_tpu.obs.chaos import (
+    ChaosEvent,
+    ChaosInjector,
+    ReplicaDeadError,
+    TickStallError,
+)
+from kubegpu_tpu.obs.metrics import MetricsRegistry
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(max_seq_len=64)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def solo(params, prompt, n, cfg):
+    out = greedy_generate(params, jnp.asarray(prompt, jnp.int32)[None],
+                          n, cfg, max_len=cfg.max_seq_len)
+    return [int(x) for x in np.asarray(out)[0]]
+
+
+def mixed_prompts(cfg, n=5):
+    return [([(i * 3 + j) % cfg.vocab_size for i in range(4 + j)],
+             5 + j) for j in range(n)]
+
+
+class TestEngineSelfDefense:
+    """ContinuousBatcher-level recovery: quarantine, replay, retry
+    bounds, watchdog, dispatch-failure retry, shed backpressure."""
+
+    def _eng(self, params, cfg, **kw):
+        kw.setdefault("n_slots", 2)
+        kw.setdefault("stride", 2)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("paged", True)
+        kw.setdefault("page_size", 8)
+        return ContinuousBatcher(params, cfg, **kw)
+
+    def test_dispatch_failure_retried_in_place(self, tiny):
+        cfg, params = tiny
+        reg = MetricsRegistry()
+        eng = self._eng(params, cfg, metrics=reg, chaos=ChaosInjector(
+            [ChaosEvent(tick=1, kind="fail_dispatch")]))
+        p = [1, 2, 3]
+        rid = eng.submit(p, 6)
+        done = eng.drain()
+        assert [r.rid for r in done] == [rid]
+        assert done[0].tokens == solo(params, p, 6, cfg)
+        assert eng.dispatch_failures == 1
+        assert reg.counter("serve_dispatch_failures") == 1
+
+    def test_nan_quarantine_replays_bit_exact(self, tiny):
+        """A poisoned slot's request must be quarantined and replayed
+        to the exact fault-free tokens; the NEIGHBOR slot must never
+        notice (slots are independent batch rows)."""
+        cfg, params = tiny
+        reg = MetricsRegistry()
+        eng = self._eng(params, cfg, metrics=reg, chaos=ChaosInjector(
+            [ChaosEvent(tick=2, kind="nan_logits")]))
+        prompts = [([(i * 3 + 1) % cfg.vocab_size for i in range(5)], 8),
+                   ([(i * 5 + 2) % cfg.vocab_size for i in range(7)], 8)]
+        rids = {eng.submit(p, n): (p, n) for p, n in prompts}
+        seen = {}
+        for r in eng.drain():
+            assert r.rid not in seen, "duplicate completion"
+            seen[r.rid] = r
+        assert set(seen) == set(rids)
+        assert eng.slots_quarantined == 1
+        assert eng.requests_retried == 1
+        assert reg.counter("serve_slots_quarantined") == 1
+        assert reg.counter("serve_requests_retried") == 1
+        for rid, (p, n) in rids.items():
+            assert seen[rid].error is None
+            assert seen[rid].tokens == solo(params, p, n, cfg), rid
+
+    def test_retry_bound_fails_gracefully(self, tiny):
+        """max_retries=0: the first quarantine exhausts the budget —
+        the request surfaces FAILED (error set, partial tokens kept),
+        and the engine keeps serving everyone else."""
+        cfg, params = tiny
+        eng = self._eng(params, cfg, max_retries=0, chaos=ChaosInjector(
+            [ChaosEvent(tick=2, kind="nan_logits")]))
+        p_a = [(i * 3 + 1) % cfg.vocab_size for i in range(5)]
+        p_b = [(i * 5 + 2) % cfg.vocab_size for i in range(7)]
+        ra = eng.submit(p_a, 8)
+        rb = eng.submit(p_b, 8)
+        done = {r.rid: r for r in eng.drain()}
+        assert set(done) == {ra, rb}
+        failed = [r for r in done.values() if r.error is not None]
+        exact = [r for r in done.values() if r.error is None]
+        assert len(failed) == 1 and "retries" in failed[0].error
+        assert len(exact) == 1
+        ok = {ra: (p_a, 8), rb: (p_b, 8)}[exact[0].rid]
+        assert exact[0].tokens == solo(params, *ok, cfg)
+
+    def test_kill_marks_dead_and_reraises(self, tiny):
+        cfg, params = tiny
+        eng = self._eng(params, cfg, chaos=ChaosInjector(
+            [ChaosEvent(tick=1, kind="kill_replica")]))
+        eng.submit([1, 2, 3], 6)
+        with pytest.raises(ReplicaDeadError):
+            eng.drain()
+        assert eng.dead is not None
+        # host-side request state survives for the failover harvest
+        assert eng.slot_req or eng.queue
+        with pytest.raises(ReplicaDeadError):
+            eng.step()
+
+    def test_watchdog_declares_stall(self, tiny):
+        cfg, params = tiny
+        eng = self._eng(params, cfg, tick_deadline_s=0.2,
+                        chaos=ChaosInjector(
+                            [ChaosEvent(tick=1, kind="stall_tick",
+                                        stall_s=0.5)]))
+        eng.warmup()
+        eng.submit([1, 2, 3], 6)
+        with pytest.raises(TickStallError):
+            eng.drain()
+        assert "watchdog" in eng.dead
+
+    def test_deadline_cancels_with_partial_tokens(self, tiny):
+        cfg, params = tiny
+        eng = self._eng(params, cfg)
+        r1 = eng.submit([1, 2, 3], 6, deadline_s=0.0)
+        r2 = eng.submit([4, 5, 6], 6)
+        done = {r.rid: r for r in eng.drain()}
+        assert done[r1].error == "deadline exceeded"
+        assert done[r2].error is None
+        assert done[r2].tokens == solo(params, [4, 5, 6], 6, cfg)
+
+    def test_cancel_api(self, tiny):
+        cfg, params = tiny
+        eng = self._eng(params, cfg, n_slots=1)
+        r1 = eng.submit([1, 2, 3], 6)
+        r2 = eng.submit([4, 5, 6], 6)   # queued behind the one slot
+        eng.step()
+        canceled = eng.cancel(r2, "user canceled")
+        assert canceled is not None and canceled.error == "user canceled"
+        done = {r.rid: r for r in eng.drain()}
+        assert set(done) == {r1}
+        assert done[r1].tokens == solo(params, [1, 2, 3], 6, cfg)
+        assert eng.cancel(12345) is None
+
+    def test_replay_exceeding_bucket_is_shed(self, tiny):
+        """A replay whose prompt + accepted tokens exceed the largest
+        bucket cannot be re-admitted: it must fail loudly (shed), not
+        park at the queue front forever."""
+        cfg, params = tiny
+        eng = self._eng(params, cfg, prompt_buckets=(8,),
+                        chaos=ChaosInjector(
+                            [ChaosEvent(tick=2, kind="nan_logits")]))
+        rid = eng.submit([1, 2, 3, 4, 5], 10)   # 5 + accepted > 8
+        done = eng.drain()
+        assert [r.rid for r in done] == [rid]
+        assert done[0].error is not None and "bucket" in done[0].error
+        assert eng.requests_shed == 1
+
+    def test_drain_diagnostic_lists_stuck_work(self, tiny):
+        """Satellite: an exhausted drain budget raises a diagnostic
+        naming the stuck slots/requests instead of silently returning
+        with work still in flight."""
+        cfg, params = tiny
+        eng = self._eng(params, cfg, n_slots=1)
+        eng.submit([1, 2, 3], 30)
+        eng.submit([4, 5, 6], 30)
+        with pytest.raises(RuntimeError) as ei:
+            eng.drain(max_ticks=2)
+        msg = str(ei.value)
+        assert "stuck work" in msg
+        assert "slot 0" in msg and "rid=0" in msg
+        assert "queued rid=1" in msg
+
+    def test_spec_degrades_to_plain_engine(self, tiny):
+        """Repeated zero-acceptance verify ticks (the untrained draft
+        rejects everything) degrade the engine to γ=0 — which IS the
+        decode-block path, so tokens stay bit-exact throughout."""
+        cfg4 = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, max_seq_len=64)
+        params4 = llama_init(jax.random.PRNGKey(0), cfg4)
+        reg = MetricsRegistry()
+        eng = ContinuousBatcher(
+            params4, cfg4, n_slots=2, stride=4, prompt_buckets=(8, 16),
+            paged=True, page_size=8, spec_gamma=3, draft_layers=1,
+            spec_degrade_after=2, metrics=reg)
+        prompts = [([(i * 3 + 1) % cfg4.vocab_size for i in range(5)], 10),
+                   ([(i * 5 + 2) % cfg4.vocab_size for i in range(7)], 10)]
+        rids = {eng.submit(p, n): (p, n) for p, n in prompts}
+        done = {r.rid: r for r in eng.drain()}
+        assert eng.spec_degraded is True
+        assert reg.counter("serve_spec_degraded") == 1
+        for rid, (p, n) in rids.items():
+            assert done[rid].tokens == solo(params4, p, n, cfg4), rid
+
+
+class TestPoolFailover:
+    """DataParallelServePool failover: seeded replica kills, stalls,
+    retry bounds, deadlines — exactly-once, bit-exact."""
+
+    def _pool(self, params, cfg, dp=2, **kw):
+        kw.setdefault("n_slots", 2)
+        kw.setdefault("stride", 2)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("page_size", 8)
+        return DataParallelServePool(params, cfg, dp=dp, tp=1, **kw)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_seeded_random_kill_exactly_once_bit_exact(self, tiny, seed):
+        """THE property test the issue demands: kill a random replica
+        at a random tick; after failover no request is lost, none is
+        duplicated, and every token stream equals the solo run."""
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        rng = np.random.default_rng(seed)
+        victim = int(rng.integers(0, 2))
+        tick = int(rng.integers(1, 6))
+        reg = MetricsRegistry()
+        pool = self._pool(params, cfg, metrics=reg, chaos={
+            victim: ChaosInjector(
+                [ChaosEvent(tick=tick, kind="kill_replica")])})
+        prompts = mixed_prompts(cfg, n=6)
+        rids = {pool.submit(p, n): (p, n) for p, n in prompts}
+        seen = {}
+        for r in pool.drain():
+            assert r.rid not in seen, f"rid {r.rid} completed twice"
+            seen[r.rid] = r
+        assert set(seen) == set(rids), "request lost"
+        assert pool.failovers == 1
+        assert victim in pool.dead_replicas
+        assert reg.counter("serve_failover_total") == 1
+        assert reg.histogram("serve_replay_ms").count >= 1
+        for rid, (p, n) in rids.items():
+            assert seen[rid].error is None, (rid, seen[rid].error)
+            assert seen[rid].tokens == solo(params, p, n, cfg), \
+                (seed, rid)
+
+    def test_stall_fails_over_via_watchdog(self, tiny):
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        pool = self._pool(params, cfg, tick_deadline_s=0.25, chaos={
+            1: ChaosInjector([ChaosEvent(tick=1, kind="stall_tick",
+                                         stall_s=0.6)])})
+        pool.warmup()   # compile outside the watchdog window
+        prompts = mixed_prompts(cfg, n=5)
+        rids = {pool.submit(p, n): (p, n) for p, n in prompts}
+        done = {r.rid: r for r in pool.drain()}
+        assert pool.failovers == 1
+        assert "watchdog" in pool.dead_replicas[1]
+        for rid, (p, n) in rids.items():
+            assert done[rid].error is None
+            assert done[rid].tokens == solo(params, p, n, cfg), rid
+
+    def test_all_replicas_dead_fails_requests_not_hangs(self, tiny):
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        pool = self._pool(params, cfg, chaos={
+            0: ChaosInjector([ChaosEvent(tick=1, kind="kill_replica")]),
+            1: ChaosInjector([ChaosEvent(tick=1, kind="kill_replica")])})
+        rids = [pool.submit(p, n) for p, n in mixed_prompts(cfg, n=4)]
+        done = {r.rid: r for r in pool.drain()}
+        assert set(done) == set(rids)     # surfaced, not hung
+        assert all(r.error is not None for r in done.values())
+        with pytest.raises(ReplicaDeadError):
+            pool.submit([1, 2, 3], 4)
+
+    def test_failover_replay_bound(self, tiny):
+        """max_replays=0: the kill's survivors fail gracefully instead
+        of replaying forever."""
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        pool = self._pool(params, cfg, max_replays=0, chaos={
+            0: ChaosInjector([ChaosEvent(tick=1, kind="kill_replica")])})
+        rids = [pool.submit(p, n) for p, n in mixed_prompts(cfg, n=6)]
+        done = {r.rid: r for r in pool.drain()}
+        assert set(done) == set(rids)
+        assert any(r.error is not None and "failover" in r.error
+                   for r in done.values())
+        # replica-1 residents were untouched and finish exactly
+        assert any(r.error is None for r in done.values())
+
+    def test_pool_deadline(self, tiny):
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        pool = self._pool(params, cfg)
+        r1 = pool.submit([1, 2, 3], 6, deadline_s=0.0)
+        r2 = pool.submit([4, 5, 6], 6)
+        done = {r.rid: r for r in pool.drain()}
+        assert done[r1].error == "deadline exceeded"
+        assert done[r2].error is None
+        assert done[r2].tokens == solo(params, [4, 5, 6], 6, cfg)
+
+
+class TestControlPlaneFailover:
+    """A dead serving replica flows through the EXISTING health
+    controller as a gang eviction; the pool observes the eviction on
+    the watch stream and fails the replica's requests over — the same
+    event path training recovery rides (scheduler/health.py)."""
+
+    def test_gang_eviction_drives_pool_failover(self, tiny):
+        from kubegpu_tpu.cluster import SimCluster, tpu_pod
+        from kubegpu_tpu.kubemeta import GangSpec
+
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        cl = SimCluster(["v5e-16", "v5e-16"])
+        try:
+            # two serving gangs = two dp replicas in the control plane
+            for g in range(2):
+                cl.submit(tpu_pod(
+                    f"serve{g}-0", chips=4, workload="serving",
+                    gang=GangSpec(name=f"serve{g}", size=1, index=0),
+                    mesh_axes={"tp": 4}, command=["noop"]))
+            result, _ = cl.step()
+            assert len(result.scheduled) == 2
+
+            pool = DataParallelServePool(
+                params, cfg, dp=2, tp=1, n_slots=2, stride=2,
+                prompt_buckets=(8, 16), page_size=8,
+                metrics=cl.metrics)
+            pool.bind_replica_gang(0, "serve0")
+            pool.bind_replica_gang(1, "serve1")
+            pool.watch_health(cl.api)
+            prompts = mixed_prompts(cfg, n=5)
+            rids = {pool.submit(p, n): (p, n) for p, n in prompts}
+            done = {}
+            for _ in range(3):
+                for r in pool.step():
+                    done[r.rid] = r
+
+            # kill the host under serving gang 0: the health controller
+            # evicts the gang (delete + recreate), the DELETED events
+            # hit the pool's watch, and the next step fails over
+            from kubegpu_tpu.kubemeta.codec import pod_allocation
+            victim = pod_allocation(cl.api.get("Pod", "serve0-0"))
+            evicted_before = cl.metrics.counter("gangs_evicted")
+            cl.fail_host(victim.node_name)
+            cl.step()
+            assert cl.metrics.counter("gangs_evicted") \
+                == evicted_before + 1
+
+            for r in pool.drain():
+                assert r.rid not in done
+                done[r.rid] = r
+            assert pool.failovers == 1
+            assert 0 in pool.dead_replicas
+            assert set(done) == set(rids)
+            for rid, (p, n) in rids.items():
+                assert done[rid].error is None, (rid, done[rid].error)
+                assert done[rid].tokens == solo(params, p, n, cfg), rid
+            # the failover also rides the scheduler's metric surface
+            assert cl.metrics.counter("serve_failover_total") == 1
+            pool.close()
+        finally:
+            cl.close()
+
+
+class TestBindConflictRetry:
+    """Satellite: a lost optimistic-concurrency race on the extender
+    bind path retries with jittered backoff, then requeues — never a
+    hard failure."""
+
+    def _cluster(self):
+        from kubegpu_tpu.cluster import SimCluster
+        return SimCluster(["v4-8"])
+
+    def test_transient_conflict_retried(self, monkeypatch):
+        from kubegpu_tpu.cluster import SimCluster, tpu_pod
+        from kubegpu_tpu.kubemeta.controlplane import Conflict
+
+        cl = SimCluster(["v4-8"])
+        try:
+            sched = cl.scheduler
+            real = sched.api.bind_pod
+            fails = {"n": 2}
+
+            def flaky(*a, **kw):
+                if fails["n"] > 0:
+                    fails["n"] -= 1
+                    raise Conflict("rv race")
+                return real(*a, **kw)
+
+            monkeypatch.setattr(sched.api, "bind_pod", flaky)
+            monkeypatch.setattr(time, "sleep", lambda s: None)
+            cl.api.create("Pod", tpu_pod("solo", chips=1,
+                                         command=["noop"]))
+            sched.sync()
+            err = sched.bind("solo", cl.agents[0].node_name)
+            assert err is None
+            assert sched.metrics.counter("bind_conflict_retries") == 2
+        finally:
+            cl.close()
+
+    def test_persistent_conflict_requeues(self, monkeypatch):
+        from kubegpu_tpu.cluster import SimCluster, tpu_pod
+        from kubegpu_tpu.kubemeta.controlplane import Conflict
+
+        cl = SimCluster(["v4-8"])
+        try:
+            sched = cl.scheduler
+
+            def always(*a, **kw):
+                raise Conflict("rv race")
+
+            monkeypatch.setattr(sched.api, "bind_pod", always)
+            monkeypatch.setattr(time, "sleep", lambda s: None)
+            cl.api.create("Pod", tpu_pod("solo", chips=1,
+                                         command=["noop"]))
+            sched.sync()
+            err = sched.bind("solo", cl.agents[0].node_name)
+            assert err is not None and "requeued" in err
+            assert sched.metrics.counter("bind_conflict_requeued") == 1
+        finally:
+            cl.close()
