@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native asan test bench bench-smoke chaos-smoke clean
+.PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke clean
 
 all: native
 
@@ -33,6 +33,16 @@ chaos-smoke:                    # seeded chaos scenario matrix (ISSUE 4):
 	# 8 virtual devices so dp failover runs for real.
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_chaos.py -q
+
+trace-smoke:                    # ISSUE 6 observability: a traced serve
+	# window must yield ONE connected span tree from extender bind
+	# through crishim injection to engine finish (valid Perfetto
+	# JSON), /metrics must parse as Prometheus 0.0.4, and every
+	# metric name observed in code must appear in the obs/metrics.py
+	# table (the name census).
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_obs_spans.py tests/test_trace_propagation.py -q
 
 clean:
 	$(MAKE) -C kubegpu_tpu/allocator/csrc clean

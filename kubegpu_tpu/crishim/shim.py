@@ -13,6 +13,7 @@ from kubegpu_tpu.crishim.runtime import ContainerHandle, ContainerRuntime
 from kubegpu_tpu.kubemeta import FakeApiServer, Pod
 from kubegpu_tpu.kubemeta.codec import pod_allocation, pod_mesh_axes
 from kubegpu_tpu.obs import get_logger
+from kubegpu_tpu.obs.spans import TRACE_ANNOTATION, TRACE_ENV, SpanContext
 from kubegpu_tpu.tpuplugin.backend import DeviceBackend
 
 log = get_logger("crishim")
@@ -20,11 +21,32 @@ log = get_logger("crishim")
 
 class CriShim:
     def __init__(self, api: FakeApiServer, backend: DeviceBackend,
-                 node_name: str, runtime: ContainerRuntime):
+                 node_name: str, runtime: ContainerRuntime,
+                 tracer=None):
         self.api = api
         self.backend = backend
         self.node_name = node_name
         self.runtime = runtime
+        # ISSUE 6: with a Tracer attached the shim records its env
+        # injection as a span and re-parents the propagated token under
+        # it, so engine spans hang off crishim.inject; without one the
+        # annotation token passes through untouched
+        self.tracer = tracer
+
+    def _propagate_trace(self, pod: Pod, env: dict) -> None:
+        """Copy the bind-time trace token from the pod annotation into
+        the container env — the same road TPU_VISIBLE_CHIPS travels."""
+        token = pod.metadata.annotations.get(TRACE_ANNOTATION)
+        ctx = SpanContext.decode(token)
+        if ctx is None:
+            return
+        if self.tracer is not None:
+            with self.tracer.span(
+                    "crishim.inject", parent=ctx,
+                    attrs={"pod": pod.name,
+                           "node": self.node_name}) as sp:
+                token = sp.context.encode()
+        env[TRACE_ENV] = token
 
     def create_container(self, pod: Pod,
                          container_index: int = 0) -> ContainerHandle:
@@ -73,6 +95,7 @@ class CriShim:
                 # close the loop: the mesh the allocator optimized
                 # placement for IS the mesh the workload builds
                 env["KUBETPU_MESH_AXES"] = json.dumps(list(axes.items()))
+        self._propagate_trace(pod, env)
         log.info("create_container", pod=pod.name, node=self.node_name,
                  chips=len(alloc.chips) if alloc else 0,
                  worker_id=alloc.worker_id if alloc else None)

@@ -63,8 +63,12 @@ class ApiServerHTTP:
     thread; close() shuts down and unsubscribes the event tap."""
 
     def __init__(self, api: FakeApiServer, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, metrics=None):
         self.api = api
+        # /metrics scrape target (ISSUE 6): Prometheus text exposition
+        # from the passed registry, defaulting to the process-global
+        # one — the same convention the scheduler webhook serves
+        self.metrics = metrics
         self._events: deque[tuple[int, WatchEvent]] = deque(
             maxlen=WATCH_BUFFER)
         self._seq = 0
@@ -108,6 +112,16 @@ class ApiServerHTTP:
                     self._send(500, {"error": str(e)})
 
             def do_GET(self):
+                if self.path.partition("?")[0] == "/metrics":
+                    # text exposition, not the JSON dispatch path
+                    body = outer._metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._dispatch("GET")
 
             def do_POST(self):
@@ -124,6 +138,12 @@ class ApiServerHTTP:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
+
+    def _metrics_text(self) -> str:
+        if self.metrics is not None:
+            return self.metrics.to_prometheus()
+        from kubegpu_tpu.obs.metrics import global_registry
+        return global_registry.to_prometheus()
 
     # -- event tap ------------------------------------------------------
 

@@ -16,9 +16,18 @@ from kubegpu_tpu.obs.chaos import (
 from kubegpu_tpu.obs.logging import configure as configure_logging
 from kubegpu_tpu.obs.logging import get_logger
 from kubegpu_tpu.obs.metrics import MetricsRegistry, global_registry
+from kubegpu_tpu.obs.spans import (
+    TRACE_ANNOTATION,
+    TRACE_ENV,
+    Span,
+    SpanContext,
+    Tracer,
+)
 from kubegpu_tpu.obs.trace import ScheduleTrace, TraceEvent
 
 __all__ = ["MetricsRegistry", "global_registry", "ScheduleTrace",
            "TraceEvent", "get_logger", "configure_logging",
            "ChaosEvent", "ChaosInjector", "DispatchFailure",
-           "ReplicaDeadError", "TickStallError"]
+           "ReplicaDeadError", "TickStallError",
+           "Tracer", "Span", "SpanContext",
+           "TRACE_ANNOTATION", "TRACE_ENV"]

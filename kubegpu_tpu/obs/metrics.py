@@ -1,78 +1,192 @@
-"""Counter / gauge / histogram registry with JSON export.
+"""Counter / gauge / histogram registry with JSON + Prometheus export.
 
 Feeds the driver-defined metrics (BASELINE.md): ``schedule_latency_ms``
 histogram (p50 is north-star #1), ``allocation_locality`` gauge per gang,
-plus scheduler throughput counters.  Thread-safe; structured-JSON export.
+plus scheduler throughput counters.  Thread-safe; structured-JSON export
+and Prometheus text exposition (0.0.4) with cumulative-bucket
+histograms, served from GET /metrics on the extender webhook, the
+scheduler daemon (``serve_prometheus``), and the kubemeta apiserver.
 
-Serving-engine histograms (observed by ``ContinuousBatcher`` when a
-registry is passed): ``serve_decode_stall_ms`` (per-tick admission work
-decode slots waited behind), ``serve_spec_accept`` (per-slot per-tick
-draft match fraction of the speculative engine), ``serve_spec_tokens_
-per_tick`` (tokens banked per slot per verify tick — accepted drafts +
-correction), and ``serve_collect_overlap_ms`` (host readout wall hidden
-behind the double-buffered next tick when ``collect_overlap`` is on).
+METRICS TABLE — every metric name the code observes.  tier-1
+(``tests/test_obs_spans.py``) greps the source for literal
+``observe/inc/set_gauge`` names and asserts each appears below, so a
+new metric without a table row fails before review, not after.
 
-Serving fault-tolerance metrics (ISSUE 4 — observed by the engine and
+Scheduler (DeviceScheduler / allocator):
+
+==============================  =========  ============================
+name                            kind       meaning
+==============================  =========  ============================
+``schedule_latency_ms``         histogram  one gang-schedule decision
+                                           wall (p50 = north-star #1)
+``allocation_locality``         gauge      locality score of the last
+                                           placed gang
+``last_allocation_locality``    gauge      alias kept for dashboards
+``gangs_scheduled``             counter    gangs placed
+``gangs_failed``                counter    gangs that found no placement
+``gangs_preempted``             counter    victim gangs evicted by
+                                           priority preemption
+``gangs_migrated``              counter    gangs moved by defrag
+``gangs_evicted``               counter    gangs evicted on device fault
+``schedule_unschedulable``      counter    decisions ending unplaceable
+``schedule_invalid``            counter    malformed/oversized asks
+``schedule_quota_denied``       counter    namespace quota rejections
+``bind_conflict_retries``       counter    bind-time rv conflicts
+                                           retried
+``bind_conflict_requeued``      counter    binds requeued after retry
+                                           budget
+``serving_spec_acceptance``     gauge      cluster-mean draft
+                                           acceptance harvested from
+                                           serve pods
+==============================  =========  ============================
+
+Serving engine (observed by ``ContinuousBatcher`` /
 ``DataParallelServePool`` when a registry is passed; the serve pod
 echoes the same names so ``DeviceScheduler.serving_metrics()`` carries
 them as scheduler-visible gauges):
 
-===========================  ==========  ================================
-name                         kind        meaning
-===========================  ==========  ================================
-``serve_failover_total``     counter     dp replicas declared dead and
-                                         failed over (kill, watchdog
-                                         stall, or control-plane gang
-                                         eviction)
-``serve_replay_ms``          histogram   wall time of one failover's
-                                         re-admission sweep (harvest +
-                                         replay submits)
-``serve_requests_retried``   counter     requests re-admitted via
-                                         bit-exact replay (engine
-                                         quarantine + pool failover)
-``serve_slots_quarantined``  counter     slots pulled from the batch on
-                                         non-finite logits
-``serve_requests_shed``      counter     admissions failed by
-                                         backpressure instead of
-                                         deadlocking the queue
-``serve_dispatch_failures``  counter     transient dispatch failures
-                                         retried in place
-``serve_tick_stalls``        counter     watchdog deadline trips
-``serve_replica_deaths``     counter     engine deaths (any cause)
-``serve_spec_degraded``      counter     engines that fell back to γ=0
-                                         on repeated zero-acceptance
-                                         verify ticks
-===========================  ==========  ================================
+==============================  =========  ============================
+name                            kind       meaning
+==============================  =========  ============================
+``serve_decode_stall_ms``       histogram  per-tick admission work
+                                           decode slots waited behind
+``serve_spec_accept``           histogram  per-slot per-tick draft
+                                           match fraction
+``serve_spec_tokens_per_tick``  histogram  tokens banked per slot per
+                                           verify tick
+``serve_collect_overlap_ms``    histogram  host readout wall hidden
+                                           behind the next tick
+``serve_ttft_ms``               histogram  submit → first output token
+                                           (queue wait + admission +
+                                           prefill; ISSUE 6)
+``serve_token_ms``              histogram  per-output-token decode
+                                           latency after the first
+                                           token (ISSUE 6)
+``serve_queue_wait_ms``         histogram  submit → admission onto a
+                                           slot (ISSUE 6)
+``serve_failover_total``        counter    dp replicas declared dead
+                                           and failed over
+``serve_replay_ms``             histogram  wall of one failover's
+                                           re-admission sweep
+``serve_requests_retried``      counter    requests re-admitted via
+                                           bit-exact replay
+``serve_slots_quarantined``     counter    slots pulled on non-finite
+                                           logits
+``serve_requests_shed``         counter    admissions failed by
+                                           backpressure
+``serve_dispatch_failures``     counter    transient dispatch failures
+                                           retried in place
+``serve_tick_stalls``           counter    watchdog deadline trips
+``serve_replica_deaths``        counter    engine deaths (any cause)
+``serve_spec_degraded``         counter    engines that fell back to
+                                           γ=0 on zero-acceptance
+==============================  =========  ============================
+
+Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as
+Chrome/Perfetto JSON, not scraped): ``sched.schedule``, ``sched.bind``,
+``crishim.inject``, ``engine.start``, ``request`` (attrs:
+``queue_wait_ms``, ``ttft_ms``, ``token_ms``, ``tokens``),
+``request.admit``, ``request.prefill_chunk``, ``request.replay``,
+``request.quarantine``, ``pool.failover``, ``engine.tick``,
+``engine.dispatch``, ``engine.verify``, ``engine.collect``,
+``engine.admit``, plus ``sched.<kind>`` instants forwarded from
+ScheduleTrace for linked gangs.  The serve pod echoes the span census
+as the ``serve_trace_spans`` metric line.  The ``cb_trace_overhead``
+bench row asserts tracing on/off is bit-exact with bounded overhead.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
-from bisect import insort
+from bisect import bisect_left
+
+# Cumulative-bucket upper bounds (ms-scale latencies — the registry's
+# histograms are all milliseconds or small ratios).  Matches the
+# Prometheus convention: each bucket counts observations <= le, and
+# +Inf is implicit (== _count).
+DEFAULT_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                   100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+# Reservoir size for percentile estimation: exact below this many
+# observations, uniform reservoir sample above (seeded — a given
+# observation sequence always yields the same percentiles).
+_RESERVOIR = 1024
 
 
 class _Histogram:
-    def __init__(self) -> None:
-        self._sorted: list[float] = []
+    """Bounded-memory histogram: cumulative buckets (Prometheus
+    exposition) + a seeded reservoir serving ``percentile()``.
+
+    The old implementation kept EVERY observation in a sorted list
+    (``insort`` = O(n) per observe, unbounded memory) — at engine tick
+    rate that is both a CPU tax in the serving loop and a leak in a
+    long-lived daemon.  Here ``observe`` is O(log buckets) and memory
+    is capped at ``_RESERVOIR`` floats; percentiles stay EXACT until
+    the cap, then degrade to a uniform sample (seeded, so
+    deterministic for a fixed observation sequence)."""
+
+    __slots__ = ("_bounds", "_bucket_counts", "_count", "_sum",
+                 "_reservoir", "_rng", "_sorted_cache")
+
+    def __init__(self, bounds: tuple = DEFAULT_BUCKETS) -> None:
+        self._bounds = bounds
+        self._bucket_counts = [0] * (len(bounds) + 1)   # last = +Inf
+        self._count = 0
+        self._sum = 0.0
+        self._reservoir: list[float] = []
+        self._rng = random.Random(0x5EED)
+        self._sorted_cache: list[float] | None = None
 
     def observe(self, v: float) -> None:
-        insort(self._sorted, v)
+        v = float(v)
+        self._count += 1
+        self._sum += v
+        # bisect_left: v exactly on a bound belongs to THAT bucket
+        # (Prometheus buckets count observations <= le)
+        self._bucket_counts[bisect_left(self._bounds, v)] += 1
+        if len(self._reservoir) < _RESERVOIR:
+            self._reservoir.append(v)
+            self._sorted_cache = None
+        else:
+            j = self._rng.randrange(self._count)
+            if j < _RESERVOIR:
+                self._reservoir[j] = v
+                self._sorted_cache = None
 
     def percentile(self, p: float) -> float:
-        if not self._sorted:
+        vals = self._sorted_cache
+        if vals is None:
+            vals = self._sorted_cache = sorted(self._reservoir)
+        if not vals:
             return 0.0
-        k = min(len(self._sorted) - 1,
-                max(0, int(round(p / 100.0 * (len(self._sorted) - 1)))))
-        return self._sorted[k]
+        k = min(len(vals) - 1,
+                max(0, int(round(p / 100.0 * (len(vals) - 1)))))
+        return vals[k]
 
     @property
     def count(self) -> int:
-        return len(self._sorted)
+        return self._count
 
     @property
     def mean(self) -> float:
-        return sum(self._sorted) / len(self._sorted) if self._sorted else 0.0
+        return self._sum / self._count if self._count else 0.0
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def buckets(self) -> list[tuple[float, int]]:
+        """Cumulative (le, count) pairs, +Inf last — the Prometheus
+        histogram exposition shape."""
+        out: list[tuple[float, int]] = []
+        acc = 0
+        for le, c in zip(self._bounds, self._bucket_counts):
+            acc += c
+            out.append((le, acc))
+        out.append((float("inf"), self._count))
+        return out
 
     def snapshot(self) -> dict:
         return {
@@ -128,26 +242,32 @@ class MetricsRegistry:
         return json.dumps(self.snapshot(), sort_keys=True)
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition (the observability surface a
-        k8s-era deployment scrapes; served at GET /metrics on the
-        extender webhook).  Histograms export as summaries with
-        p50/p90/p99 quantiles plus _count and _sum.  A name registered
-        as BOTH gauge and histogram (harvest_workload_metrics does
-        this) exports the gauge as ``<name>_last`` — a duplicate metric
-        family is a hard parse error that would fail the whole scrape.
-        One locked pass, reusing _Histogram's own percentile math."""
+        """Prometheus text exposition 0.0.4 (the observability surface
+        a k8s-era deployment scrapes; served at GET /metrics on the
+        extender webhook, the scheduler daemon, and the kubemeta
+        apiserver).  Histograms export as CUMULATIVE BUCKETS
+        (``_bucket{le="..."}`` + ``_count`` + ``_sum`` — ISSUE 6), so
+        quantiles aggregate across scrape targets server-side
+        (histogram_quantile), which summaries cannot.  A name
+        registered as BOTH gauge and histogram
+        (harvest_workload_metrics does this) exports the gauge as
+        ``<name>_last`` — a duplicate metric family is a hard parse
+        error that would fail the whole scrape.  One locked pass."""
         def sanitize(name: str) -> str:
             return "kubetpu_" + "".join(
                 c if c.isalnum() or c == "_" else "_" for c in name)
+
+        def fmt_le(le: float) -> str:
+            if le == float("inf"):
+                return "+Inf"
+            return repr(le) if le != int(le) else str(int(le))
 
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
             hist_names = set(self._hists)
-            hist_stats = [
-                (k, h.percentile(50), h.percentile(90), h.percentile(99),
-                 h.count, h.mean * h.count)
-                for k, h in sorted(self._hists.items())]
+            hist_rows = [(k, h.buckets(), h.count, h.sum)
+                         for k, h in sorted(self._hists.items())]
         lines: list[str] = []
         for name, v in counters:
             m = sanitize(name)
@@ -155,15 +275,62 @@ class MetricsRegistry:
         for name, v in gauges:
             m = sanitize(name + "_last" if name in hist_names else name)
             lines += [f"# TYPE {m} gauge", f"{m} {v}"]
-        for name, p50, p90, p99, n, total in hist_stats:
+        for name, buckets, n, total in hist_rows:
             m = sanitize(name)
-            lines.append(f"# TYPE {m} summary")
-            lines.append(f'{m}{{quantile="0.5"}} {p50}')
-            lines.append(f'{m}{{quantile="0.9"}} {p90}')
-            lines.append(f'{m}{{quantile="0.99"}} {p99}')
+            lines.append(f"# TYPE {m} histogram")
+            for le, c in buckets:
+                lines.append(f'{m}_bucket{{le="{fmt_le(le)}"}} {c}')
             lines.append(f"{m}_count {n}")
             lines.append(f"{m}_sum {total}")
         return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> dict[str, dict]:
+    """Minimal 0.0.4 parser for the trace-smoke gate: returns
+    family → {"type", "samples": {name+labels: value}} and raises
+    ValueError on malformed lines, duplicate families, or
+    non-monotonic histogram buckets."""
+    families: dict[str, dict] = {}
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, rest = ln.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            if name in families:
+                raise ValueError(f"duplicate family {name}")
+            if kind not in ("counter", "gauge", "histogram", "summary"):
+                raise ValueError(f"bad type {kind!r} for {name}")
+            families[name] = {"type": kind, "samples": {}}
+            continue
+        if ln.startswith("#"):
+            continue
+        key, _, val = ln.rpartition(" ")
+        if not key:
+            raise ValueError(f"malformed sample line {ln!r}")
+        float(val)   # must parse
+        base = key.split("{", 1)[0]
+        fam = base
+        for suffix in ("_bucket", "_count", "_sum"):
+            if base.endswith(suffix) and base[:-len(suffix)] in families:
+                fam = base[:-len(suffix)]
+                break
+        if fam not in families:
+            raise ValueError(f"sample {key!r} without TYPE line")
+        families[fam]["samples"][key] = float(val)
+    for name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        pairs = []
+        for key, v in fam["samples"].items():
+            if key.startswith(name + "_bucket{le=\""):
+                le = key.split('le="', 1)[1].rstrip('"}')
+                pairs.append((float("inf") if le == "+Inf"
+                              else float(le), v))
+        pairs.sort()
+        if any(b[1] < a[1] for a, b in zip(pairs, pairs[1:])):
+            raise ValueError(f"non-monotonic buckets in {name}")
+    return families
 
 
 def percentiles(values, ps=(50, 90, 99)) -> dict:
@@ -189,11 +356,11 @@ def serve_prometheus(registry: MetricsRegistry, host: str = "127.0.0.1",
                      port: int = 0):
     """Standalone Prometheus scrape endpoint (GET /metrics) for daemon
     processes that have no other HTTP server — the extender webhook
-    integrates the same surface into its own dispatch; this is the
-    scheduler daemon's.  ``host`` matters in a container netns (a
-    loopback-only bind is unreachable from an off-host scraper).
-    Returns the started ThreadingHTTPServer; call ``shutdown()`` +
-    ``server_close()`` to stop."""
+    and the kubemeta apiserver integrate the same surface into their
+    own dispatch; this is the scheduler daemon's.  ``host`` matters in
+    a container netns (a loopback-only bind is unreachable from an
+    off-host scraper).  Returns the started ThreadingHTTPServer; call
+    ``shutdown()`` + ``server_close()`` to stop."""
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 

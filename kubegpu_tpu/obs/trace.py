@@ -3,6 +3,12 @@
 SURVEY.md §6 "Tracing": per-decision record of the candidates considered,
 scores, the winner, and phase timings — the debuggability layer the
 reference lacked.
+
+ISSUE 6: construct with ``tracer=`` to ALSO forward every recorded
+decision into a :class:`~kubegpu_tpu.obs.spans.Tracer` — decisions whose
+gang the extender linked to a request trace (``Tracer.link_gang``)
+become instant events on that trace, so control-plane scheduling and
+engine ticks land on one Perfetto timeline.
 """
 
 from __future__ import annotations
@@ -10,6 +16,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field, asdict
 
 
@@ -22,18 +29,21 @@ class TraceEvent:
 
 
 class ScheduleTrace:
-    def __init__(self, capacity: int = 4096) -> None:
+    def __init__(self, capacity: int = 4096, tracer=None) -> None:
         self._lock = threading.Lock()
-        self._events: list[TraceEvent] = []
-        self._capacity = capacity
+        # deque(maxlen=) evicts O(1); the old list.pop(0) shifted the
+        # whole ring every record once full — O(capacity) per decision
+        # in a long-lived daemon
+        self._events: deque[TraceEvent] = deque(maxlen=capacity)
+        self._tracer = tracer
 
     def record(self, kind: str, gang: str = "", **detail) -> None:
         with self._lock:
-            if len(self._events) >= self._capacity:
-                self._events.pop(0)
             self._events.append(
                 TraceEvent(ts=time.time(), kind=kind, gang=gang,
                            detail=detail))
+        if self._tracer is not None and gang:
+            self._tracer.ingest_schedule_event(kind, gang, detail)
 
     def events(self, kind: str | None = None) -> list[TraceEvent]:
         with self._lock:

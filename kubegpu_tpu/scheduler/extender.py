@@ -57,6 +57,7 @@ from kubegpu_tpu.kubemeta.codec import (
 )
 from kubegpu_tpu.kubemeta.objects import GangSpec
 from kubegpu_tpu.obs import MetricsRegistry, ScheduleTrace, get_logger
+from kubegpu_tpu.obs.spans import TRACE_ANNOTATION
 from kubegpu_tpu.tpuplugin.backend import NodeAdvertisement
 
 log = get_logger("scheduler")
@@ -90,11 +91,18 @@ class DeviceScheduler:
                  coordinator_port: int = 8476,
                  gang_grace_s: float = 30.0,
                  max_planning_victims: int = 16,
-                 bind_retries: int = 3):
+                 bind_retries: int = 3,
+                 tracer=None):
         self.api = api
         self.allocator = allocator or GangAllocator()
         self.metrics = metrics or MetricsRegistry()
-        self.trace = trace or ScheduleTrace()
+        # request tracing (ISSUE 6): when a Tracer is attached, each
+        # gang decision roots a trace whose propagation token rides the
+        # bind annotation into the crishim env (the TPU_VISIBLE_CHIPS
+        # road); a default-constructed ScheduleTrace shares the tracer
+        # so decision events join request traces by gang id
+        self.tracer = tracer
+        self.trace = trace or ScheduleTrace(tracer=tracer)
         self.coordinator_port = coordinator_port
         # How long an INCOMPLETE gang at the head of the queue blocks
         # later-arrived units (the arrival grace; cf. Volcano/coscheduling
@@ -532,10 +540,12 @@ class DeviceScheduler:
         self._gang_priority[gkey] = pod.spec.priority
         self._gang_migratable[gkey] = pod_migratable(pod)
         self._pod_gang[gkey] = gkey
+        self._trace_schedule_root(gkey, t0, locality=asg.locality)
         self._write_retrying(
             self.api.patch_annotations, "Pod", pod.name,
             {ALLOCATE_FROM_KEY: allocation_to_annotation(allocations[0]),
-             MIGRATION_DEBT_KEY: None},   # repaid via the wire path too
+             MIGRATION_DEBT_KEY: None,   # repaid via the wire path too
+             **self._trace_bind_annotation(gkey, pod.name, node_name)},
             namespace=ns)
         self._write_retrying(self.api.bind_pod, pod.name, node_name,
                              namespace=ns)
@@ -560,10 +570,12 @@ class DeviceScheduler:
         if node != node_name:
             return (f"gang member is assigned to {node}, refusing bind "
                     f"to {node_name}")
+        self._trace_schedule_root(gkey, t0, wire=True)
         self._write_retrying(
             self.api.patch_annotations, "Pod", pod.name,
             {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc),
-             MIGRATION_DEBT_KEY: None},   # repaid via the wire path too
+             MIGRATION_DEBT_KEY: None,   # repaid via the wire path too
+             **self._trace_bind_annotation(gkey, pod.name, node_name)},
             namespace=ns)
         self._write_retrying(self.api.bind_pod, pod.name, node_name,
                              namespace=ns)
@@ -1152,6 +1164,9 @@ class DeviceScheduler:
             pod_migratable(p) for p in members)
         self._migration_debts.pop(gang_name, None)   # debt repaid
         bare_gang = self._split_gkey(gang_name)[1]
+        self._trace_schedule_root(gang_name, t0, slice=asg.slice_id,
+                                  locality=asg.locality,
+                                  score=asg.score)
         for pod, alloc in zip(members, allocations):
             alloc.gang_name = bare_gang   # wire format: bare name
             self._pod_gang[self._gkey(pod.metadata.namespace,
@@ -1160,7 +1175,9 @@ class DeviceScheduler:
                 "Pod", pod.name,
                 {ALLOCATE_FROM_KEY: allocation_to_annotation(alloc),
                  # debt repaid: drop the persisted home reservation
-                 MIGRATION_DEBT_KEY: None},
+                 MIGRATION_DEBT_KEY: None,
+                 **self._trace_bind_annotation(
+                     gang_name, pod.name, alloc.node_name)},
                 namespace=pod.metadata.namespace)
             self._write_retrying(self.api.bind_pod, pod.name,
                                   alloc.node_name,
@@ -1183,6 +1200,38 @@ class DeviceScheduler:
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.observe("schedule_latency_ms", ms)
         self.metrics.inc("gangs_scheduled" if scheduled else "gangs_failed")
+
+    # ------------------------------------------------------------------
+    # Request tracing (ISSUE 6): root span per gang decision + a bind
+    # span per pod whose context is THE propagation token
+    # ------------------------------------------------------------------
+
+    def _trace_schedule_root(self, gkey: str, t0: float, **attrs) -> None:
+        """Root a trace for this gang's decision (backdated to t0) and
+        link the gang id, so subsequent ScheduleTrace events and every
+        downstream layer (crishim, engine) join the same trace.
+        Idempotent: a gang already linked keeps its root."""
+        if self.tracer is None or self.tracer.gang_context(gkey):
+            return
+        sp = self.tracer.start_span(
+            "sched.schedule", attrs={"gang": gkey, **attrs})
+        sp.t0 = t0
+        self.tracer.link_gang(gkey, sp)
+        sp.end()
+
+    def _trace_bind_annotation(self, gkey: str, pod_name: str,
+                               node: str) -> dict:
+        """Record one pod's bind span and return the annotation
+        fragment carrying its propagation token ({} when tracing is
+        off — the patch stays byte-identical to the untraced build)."""
+        if self.tracer is None:
+            return {}
+        with self.tracer.span("sched.bind",
+                              parent=self.tracer.gang_context(gkey),
+                              attrs={"gang": gkey, "pod": pod_name,
+                                     "node": node}) as sp:
+            token = sp.context.encode()
+        return {TRACE_ANNOTATION: token}
 
     # ------------------------------------------------------------------
     # Pod lifecycle: return resources on completion/deletion (§4.4)

@@ -243,11 +243,23 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                      "; ".join(bad) + " — single-chip engine would "
                      "serve instead of the mesh-sharded one")
             tp = dp = 1
+    # end-to-end request tracing (ISSUE 6): the crishim injects
+    # KUBETPU_TRACE_CONTEXT into this pod's env at create_container —
+    # the same road TPU_VISIBLE_CHIPS travels.  Decoding it parents
+    # every engine span (ticks, admissions, TTFT) under the
+    # scheduler's bind span, one trace per request end to end.  No
+    # token (or SERVE_TRACE=1 for a local root) → tracing stays off
+    # and the engine runs the untraced fast path.
+    from kubegpu_tpu.obs.spans import TRACE_ENV, SpanContext, Tracer
+    trace_ctx = SpanContext.decode(os.environ.get(TRACE_ENV))
+    tracer = (Tracer() if trace_ctx is not None
+              or os.environ.get("SERVE_TRACE") == "1" else None)
     eng_kw = dict(n_slots=n_slots, max_len=max_len, stride=stride,
                   prompt_buckets=(prompt_t,), paged=paged,
                   page_size=page_size, kv_int8=kv_int8,
                   prefix_cache=prefix_cache, chunked_prefill=chunked,
-                  spec_gamma=spec_gamma, draft_layers=draft_layers)
+                  spec_gamma=spec_gamma, draft_layers=draft_layers,
+                  tracer=tracer, trace_ctx=trace_ctx)
     if paged and dp > 1:
         from kubegpu_tpu.models.serve import DataParallelServePool
         eng = DataParallelServePool(params, cfg, dp=dp, tp=tp,
@@ -349,6 +361,16 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                  eng.requests_shed if hasattr(eng, "requests_shed")
                  else sum(e.requests_shed for e in eng.replicas))):
             print(json.dumps({"metric": name, "value": value}))
+        if tracer is not None:
+            # trace echo: span count is harvestable; the full Perfetto
+            # JSON goes to SERVE_TRACE_OUT when asked (validated by
+            # make trace-smoke)
+            print(json.dumps({"metric": "serve_trace_spans",
+                              "value": len(tracer.spans())}))
+            trace_out = os.environ.get("SERVE_TRACE_OUT")
+            if trace_out:
+                with open(trace_out, "w") as f:
+                    f.write(tracer.to_chrome_trace())
     if not ok:
         print("FAIL: continuous engine dropped or corrupted requests",
               file=sys.stderr)

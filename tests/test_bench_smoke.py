@@ -112,3 +112,20 @@ def test_serving_bench_smoke_parses_and_carries_keys():
         assert g["verify_ticks"] < row["off"]["ticks"]
         assert g["engine_tokens_per_s_anchored"] > 0
         assert row["best_gamma"] == 3
+
+    # tracing overhead (ISSUE 6): same window traced vs untraced — the
+    # gate is bit-exactness + a populated, valid trace; the honest
+    # overhead figure is the per-tick µs delta (raw wall ratio is CPU
+    # weather, so its bound is deliberately loose).
+    to = doc["cb_trace_overhead"]
+    assert to["protocol"] == "same_window_traced_vs_untraced_best_of"
+    assert to["bit_exact"] is True
+    assert to["chrome_trace_valid"] is True
+    assert to["spans"] > 0
+    assert to["engine_ticks_traced"] > 0
+    assert to["chrome_trace_events"] >= to["spans"]
+    for name in ("engine.tick", "engine.dispatch", "engine.collect",
+                 "request"):
+        assert name in to["span_names"], name
+    assert to["trace_overhead_us_per_tick"] < 2000
+    assert to["overhead_x_raw_weather"] < 3.0

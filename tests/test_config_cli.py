@@ -210,4 +210,4 @@ class TestExampleSpecs:
                    "--format", "prometheus"])
         out = capsys.readouterr().out
         assert rc == 0
-        assert "# TYPE kubetpu_schedule_latency_ms summary" in out
+        assert "# TYPE kubetpu_schedule_latency_ms histogram" in out

@@ -1,0 +1,340 @@
+"""ISSUE 6 observability primitives: span tracer, bounded histograms,
+Prometheus exposition, and the metric/span-name census.
+
+The census tests are the tier-1 gate the ``obs/metrics.py`` docstring
+promises: every literal metric name the package observes (and every
+literal span name it records) must appear in the METRICS TABLE, so a
+new metric without a table row fails here, before review.
+"""
+
+import json
+import pathlib
+import re
+
+import pytest
+
+from kubegpu_tpu.obs.metrics import (
+    _RESERVOIR,
+    MetricsRegistry,
+    _Histogram,
+    parse_prometheus,
+    percentiles,
+)
+from kubegpu_tpu.obs.spans import (
+    SpanContext,
+    Tracer,
+    validate_chrome_trace,
+)
+from kubegpu_tpu.obs.trace import ScheduleTrace
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent / "kubegpu_tpu"
+
+
+# ---------------------------------------------------------------------------
+# SpanContext: the wire token
+# ---------------------------------------------------------------------------
+
+def test_span_context_roundtrip():
+    ctx = SpanContext("abc123", "def456")
+    assert ctx.encode() == "abc123:def456"
+    back = SpanContext.decode(ctx.encode())
+    assert back == ctx
+    assert back.trace_id == "abc123" and back.span_id == "def456"
+
+
+@pytest.mark.parametrize("junk", [None, "", "nocolon", ":orphan",
+                                  "orphan:", ":"])
+def test_span_context_junk_decodes_to_none(junk):
+    # junk in the annotation/env must disable tracing, not crash the pod
+    assert SpanContext.decode(junk) is None
+
+
+# ---------------------------------------------------------------------------
+# Tracer: trees, cross-process parenting, capacity, export
+# ---------------------------------------------------------------------------
+
+def test_tracer_parent_child_same_trace():
+    tr = Tracer()
+    with tr.span("root") as root:
+        with tr.span("child", parent=root) as child:
+            pass
+    assert root.parent_id == ""
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert len(tr.trace_ids()) == 1
+
+
+def test_tracer_cross_process_parenting_via_token():
+    upstream = Tracer()
+    with upstream.span("sched.bind") as bind:
+        token = bind.context.encode()
+    # a different process decodes the token and parents under it
+    downstream = Tracer()
+    ctx = SpanContext.decode(token)
+    with downstream.span("crishim.inject", parent=ctx) as inj:
+        pass
+    assert inj.trace_id == bind.trace_id
+    assert inj.parent_id == bind.span_id
+
+
+def test_tracer_add_span_backdates():
+    tr = Tracer()
+    sp = tr.add_span("engine.tick", 10.0, 10.5, attrs={"tick": 3})
+    assert sp.t0 == 10.0 and sp.t1 == 10.5
+    assert tr.spans(name="engine.tick")[0].attrs["tick"] == 3
+
+
+def test_tracer_capacity_evicts_oldest():
+    tr = Tracer(capacity=4)
+    for i in range(10):
+        tr.add_span(f"s{i}", float(i), float(i) + 0.5)
+    got = tr.spans()
+    assert len(got) == 4
+    assert [s.name for s in got] == ["s6", "s7", "s8", "s9"]
+
+
+def test_tracer_span_tree_connectivity():
+    tr = Tracer()
+    root = tr.start_span("request")
+    a = tr.start_span("request.admit_span", parent=root)
+    b = tr.start_span("engine.tick", parent=root)
+    c = tr.start_span("engine.dispatch", parent=b)
+    for s in (c, b, a, root):
+        s.end()
+    tree = tr.span_tree(root.trace_id)
+    assert {s.name for s in tree[""]} == {"request"}
+    assert {s.name for s in tree[root.span_id]} == {"request.admit_span",
+                                                    "engine.tick"}
+    assert {s.name for s in tree[b.span_id]} == {"engine.dispatch"}
+    # every non-root parent id resolves to a recorded span
+    ids = {s.span_id for s in tr.spans(root.trace_id)}
+    dangling = [s for s in tr.spans(root.trace_id)
+                if s.parent_id and s.parent_id not in ids]
+    assert dangling == []
+
+
+def test_tracer_chrome_export_and_validation():
+    tr = Tracer()
+    with tr.span("request", attrs={"rid": 1}) as req:
+        tr.instant("request.admit", req, attrs={"slot": 0})
+        with tr.span("engine.tick", parent=req):
+            pass
+    text = tr.to_chrome_trace()
+    events = validate_chrome_trace(text)
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+    assert {e["name"] for e in by_ph["X"]} == {"request", "engine.tick"}
+    assert {e["name"] for e in by_ph["i"]} == {"request.admit"}
+    # ids ride in args so the tree is reconstructible from the export
+    req_ev = next(e for e in by_ph["X"] if e["name"] == "request")
+    tick_ev = next(e for e in by_ph["X"] if e["name"] == "engine.tick")
+    assert tick_ev["args"]["parent_id"] == req_ev["args"]["span_id"]
+    assert req_ev["args"]["rid"] == 1
+    # events are time-sorted
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+
+
+def test_tracer_chrome_export_trace_filter():
+    tr = Tracer()
+    with tr.span("a") as a:
+        pass
+    with tr.span("b"):
+        pass
+    events = validate_chrome_trace(tr.to_chrome_trace(a.trace_id))
+    assert [e["name"] for e in events] == ["a"]
+
+
+@pytest.mark.parametrize("doc", [
+    {"notTraceEvents": []},
+    {"traceEvents": [{"ph": "Z", "ts": 0}]},
+    {"traceEvents": [{"ph": "X", "ts": "soon", "dur": 1}]},
+    {"traceEvents": [{"ph": "X", "ts": 0.0}]},          # X without dur
+])
+def test_validate_chrome_trace_rejects_bad_shapes(doc):
+    with pytest.raises(ValueError):
+        validate_chrome_trace(json.dumps(doc))
+
+
+# ---------------------------------------------------------------------------
+# ScheduleTrace: bounded ring + tracer forwarding
+# ---------------------------------------------------------------------------
+
+def test_schedule_trace_bounded_eviction():
+    st = ScheduleTrace(capacity=8)
+    for i in range(20):
+        st.record("schedule", gang=f"g{i}")
+    evs = st.events()
+    assert len(evs) == 8
+    assert [e.gang for e in evs] == [f"g{i}" for i in range(12, 20)]
+
+
+def test_schedule_trace_forwards_linked_gangs_only():
+    tr = Tracer()
+    st = ScheduleTrace(tracer=tr)
+    with tr.span("sched.schedule") as root:
+        tr.link_gang("ns/linked", root)
+    st.record("schedule", gang="ns/linked", node="n0", score=0.5,
+              candidates=["n0", "n1"])           # list attr filtered out
+    st.record("schedule", gang="ns/unlinked", node="n1")
+    st.record("heartbeat")                        # gangless, dropped
+    events = validate_chrome_trace(tr.to_chrome_trace(root.trace_id))
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(instants) == 1
+    ev = instants[0]
+    assert ev["name"] == "sched.schedule"
+    assert ev["args"]["gang"] == "ns/linked"
+    assert ev["args"]["node"] == "n0" and ev["args"]["score"] == 0.5
+    assert "candidates" not in ev["args"]
+    assert tr.gang_context("ns/unlinked") is None
+
+
+# ---------------------------------------------------------------------------
+# Bounded histogram + Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def test_histogram_exact_percentiles_below_cap():
+    h = _Histogram()
+    for v in range(100):
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.percentile(0) == 0.0
+    assert h.percentile(50) == pytest.approx(50.0, abs=1.0)
+    assert h.percentile(100) == 99.0
+    assert h.mean == pytest.approx(49.5)
+
+
+def test_histogram_memory_bounded_at_scale():
+    h = _Histogram()
+    n = 100_000
+    for i in range(n):
+        h.observe(float(i % 1000))
+    assert h.count == n
+    assert len(h._reservoir) <= _RESERVOIR
+    # reservoir percentiles stay a sane estimate of the population
+    assert 350.0 <= h.percentile(50) <= 650.0
+    # deterministic: the seeded reservoir replays identically
+    h2 = _Histogram()
+    for i in range(n):
+        h2.observe(float(i % 1000))
+    assert h2.percentile(50) == h.percentile(50)
+    assert h2.percentile(99) == h.percentile(99)
+
+
+def test_histogram_buckets_cumulative_monotone():
+    h = _Histogram()
+    for v in (0.05, 0.3, 0.7, 3.0, 30.0, 3000.0, 99999.0):
+        h.observe(v)
+    buckets = h.buckets()
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts)
+    assert buckets[-1][0] == float("inf")
+    assert buckets[-1][1] == h.count
+    # an out-of-range observation lands only in +Inf
+    les = dict(buckets)
+    assert les[10000.0] == h.count - 1
+
+
+def test_registry_prometheus_roundtrip():
+    reg = MetricsRegistry()
+    reg.inc("gangs_scheduled", 3)
+    reg.set_gauge("allocation_locality", 0.75)
+    for v in (1.0, 2.0, 40.0):
+        reg.observe("schedule_latency_ms", v)
+    text = reg.to_prometheus()
+    fams = parse_prometheus(text)
+    assert fams["kubetpu_gangs_scheduled"]["type"] == "counter"
+    assert fams["kubetpu_gangs_scheduled"]["samples"][
+        "kubetpu_gangs_scheduled"] == 3.0
+    assert fams["kubetpu_allocation_locality"]["type"] == "gauge"
+    hist = fams["kubetpu_schedule_latency_ms"]
+    assert hist["type"] == "histogram"
+    assert hist["samples"]["kubetpu_schedule_latency_ms_count"] == 3.0
+    assert hist["samples"]["kubetpu_schedule_latency_ms_sum"] == 43.0
+    assert hist["samples"][
+        'kubetpu_schedule_latency_ms_bucket{le="+Inf"}'] == 3.0
+    assert hist["samples"][
+        'kubetpu_schedule_latency_ms_bucket{le="1"}'] == 1.0
+
+
+def test_registry_gauge_histogram_collision_exports_last():
+    # harvest_workload_metrics registers serve names as BOTH gauge and
+    # histogram; a duplicate family is a hard Prometheus parse error
+    reg = MetricsRegistry()
+    reg.observe("serve_ttft_ms", 12.0)
+    reg.set_gauge("serve_ttft_ms", 12.0)
+    fams = parse_prometheus(reg.to_prometheus())
+    assert fams["kubetpu_serve_ttft_ms"]["type"] == "histogram"
+    assert fams["kubetpu_serve_ttft_ms_last"]["type"] == "gauge"
+
+
+def test_parse_prometheus_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus("# TYPE a counter\n# TYPE a counter\na 1\n")
+    with pytest.raises(ValueError):
+        parse_prometheus("orphan_sample 1\n")
+    with pytest.raises(ValueError):
+        parse_prometheus("# TYPE h histogram\n"
+                         'h_bucket{le="1"} 5\n'
+                         'h_bucket{le="2"} 3\n'
+                         "h_count 5\nh_sum 9\n")
+
+
+def test_percentiles_helper_matches_histogram():
+    vals = [float(v) for v in range(200)]
+    out = percentiles(vals)
+    h = _Histogram()
+    for v in vals:
+        h.observe(v)
+    assert out["count"] == 200
+    assert out["p50"] == h.percentile(50)
+    assert out["p99"] == h.percentile(99)
+
+
+# ---------------------------------------------------------------------------
+# Name census: code ↔ METRICS TABLE
+# ---------------------------------------------------------------------------
+
+def _package_sources():
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        yield path, path.read_text()
+
+
+# \s* after the paren: several call sites wrap the name onto the next
+# line (e.g. the multiline serve_spec_tokens_per_tick observe)
+_METRIC_CALL = re.compile(
+    r"\.(?:inc|observe|set_gauge)\(\s*[\"']([a-z0-9_]+)[\"']", re.S)
+
+_SPAN_CALL = re.compile(
+    r"\.(?:start_span|span|add_span|instant)\(\s*[\"']"
+    r"([a-z0-9_]+\.[a-z0-9_.]+|request)[\"']", re.S)
+
+
+def _metrics_doc() -> str:
+    import kubegpu_tpu.obs.metrics as m
+    return m.__doc__
+
+
+def test_every_observed_metric_name_is_in_the_table():
+    doc = _metrics_doc()
+    missing = {}
+    for path, src in _package_sources():
+        for name in _METRIC_CALL.findall(src):
+            if f"``{name}``" not in doc:
+                missing.setdefault(name, path.name)
+    assert not missing, (
+        f"metrics observed in code but absent from the METRICS TABLE in "
+        f"obs/metrics.py: {missing}")
+
+
+def test_every_recorded_span_name_is_in_the_table():
+    doc = _metrics_doc()
+    missing = {}
+    for path, src in _package_sources():
+        for name in _SPAN_CALL.findall(src):
+            if f"``{name}``" not in doc:
+                missing.setdefault(name, path.name)
+    assert not missing, (
+        f"span names recorded in code but absent from the span list in "
+        f"obs/metrics.py: {missing}")

@@ -385,7 +385,7 @@ class TestBindVerb:
 class TestMetricsEndpoint:
     def test_prometheus_scrape(self, cluster_and_server):
         """GET /metrics serves Prometheus text with the schedule-latency
-        summary (north-star #1) after real decisions."""
+        histogram (north-star #1) after real decisions."""
         cl, srv = cluster_and_server
         cl.submit(tpu_pod("p", chips=1, command=["x"]))
         cl.step()
@@ -393,10 +393,14 @@ class TestMetricsEndpoint:
         with urllib.request.urlopen(req, timeout=10) as resp:
             assert resp.headers["Content-Type"].startswith("text/plain")
             body = resp.read().decode()
-        assert "# TYPE kubetpu_schedule_latency_ms summary" in body
-        assert 'kubetpu_schedule_latency_ms{quantile="0.5"}' in body
+        assert "# TYPE kubetpu_schedule_latency_ms histogram" in body
+        assert 'kubetpu_schedule_latency_ms_bucket{le="+Inf"} 1' in body
         assert "kubetpu_schedule_latency_ms_count 1" in body
         assert "# TYPE kubetpu_gangs_scheduled counter" in body
+        # cumulative-bucket exposition must parse + stay monotonic
+        from kubegpu_tpu.obs.metrics import parse_prometheus
+        fams = parse_prometheus(body)
+        assert fams["kubetpu_schedule_latency_ms"]["type"] == "histogram"
 
     def test_unknown_get_404(self, cluster_and_server):
         cl, srv = cluster_and_server
@@ -420,4 +424,4 @@ class TestMetricsEndpoint:
                     if ln.startswith("# TYPE")]
         assert len(families) == len(set(families)), families
         assert "# TYPE kubetpu_workload_bw_last gauge" in text
-        assert "# TYPE kubetpu_workload_bw summary" in text
+        assert "# TYPE kubetpu_workload_bw histogram" in text
