@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke clean
+.PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
+        fused-smoke clean
 
 all: native
 
@@ -33,6 +34,17 @@ chaos-smoke:                    # seeded chaos scenario matrix (ISSUE 4):
 	# 8 virtual devices so dp failover runs for real.
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_chaos.py -q
+
+fused-smoke:                    # ISSUE 8 fused multi-tick decode: K=4
+	# bit-exact vs K=1 under prefix cache + chunked prefill + spec +
+	# tp=2, page-pool invariants under fused-budget churn, mid-block
+	# quarantine replay, and the cb_fused_ticks host-overhead gate.
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_serve.py tests/test_page_pool.py \
+		tests/test_serve_chaos.py -q -k "Fused or fused"
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py -q
 
 trace-smoke:                    # ISSUE 6 observability: a traced serve
 	# window must yield ONE connected span tree from extender bind

@@ -580,6 +580,87 @@ def _cb_spec_bench(params, cfg, slots: int, prompt: int, new: int,
     return out
 
 
+def _cb_fused_bench(params, cfg, slots: int, prompt: int, new: int,
+                    stride: int, page: int, reqs: int,
+                    ks: tuple = (1, 2, 4, 8), prompts=None,
+                    repeats: int = 2) -> dict:
+    """Fused multi-tick decode A/B (ISSUE 8 tentpole row): the SAME
+    request window drained by paged engines at each fused depth K —
+    K=1 is today's one-host-sync-per-tick engine, K>1 runs K complete
+    decode ticks inside one ``lax.scan`` and fetches one concatenated
+    block.  Reports, per K: token parity vs the K=1 leg (the greedy
+    bit-exact contract, also asserted in tier-1), fused dispatch/stall
+    counters, wall tok/s, and the headline ``host_ms_per_token`` — the
+    per-token host-side overhead (step wall MINUS device sync) that
+    fused ticks exist to amortize.  Best-of-``repeats`` by
+    host_ms_per_token so one GC pause doesn't decide the row."""
+    import numpy as np
+
+    from kubegpu_tpu.models.serve import ContinuousBatcher
+
+    cb_len = prompt + new + stride + 8
+    if prompts is None:
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(1, cfg.vocab_size, size=prompt)
+                   for _ in range(reqs)]
+    stream = [(np.asarray(p, np.int32), new) for p in prompts[:reqs]]
+    out = {"protocol": "same_window_fused_k_sweep", "ks": list(ks),
+           "requests": len(stream), "new_tokens": new, "stride": stride,
+           "by_k": {}}
+
+    def leg(k):
+        eng = ContinuousBatcher(
+            params, cfg, n_slots=slots, max_len=cb_len, stride=stride,
+            prompt_buckets=(prompt,), paged=True, page_size=page,
+            fused_ticks=k)
+        eng.warmup()
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, n) for p, n in stream]
+        done = {r.rid: r.tokens for r in eng.drain()}
+        wall = time.perf_counter() - t0
+        return eng, [done[r] for r in rids], wall
+
+    base_tokens = None
+    parity_all = True
+    for k in ks:
+        best = None
+        for _ in range(repeats):
+            eng, tokens, wall = leg(k)
+            n_tok = sum(len(t) for t in tokens)
+            host_ms = sum(eng.host_overhead_ms)
+            hpt = host_ms / n_tok if n_tok else float("inf")
+            cand = {
+                "tokens": n_tok,
+                "ticks": eng._tick,
+                "steps": len(eng.host_overhead_ms),
+                "fused_dispatches": eng.fused_dispatches,
+                "fused_ticks_run": eng.fused_ticks_run,
+                "fused_stalls": eng.fused_stalls,
+                "host_ms_per_token": round(hpt, 4),
+                "tokens_per_s_wall": round(n_tok / wall, 1),
+                "fused_block_ms": round(
+                    float(np.mean(eng.fused_block_ms)), 3)
+                if eng.fused_block_ms else None,
+            }
+            del eng
+            if best is None or hpt < best[0]:
+                best = (hpt, cand, tokens)
+        hpt, row, tokens = best
+        if base_tokens is None:
+            base_tokens = tokens        # first K in ks must be 1
+        row["parity_vs_k1"] = tokens == base_tokens
+        parity_all = parity_all and row["parity_vs_k1"]
+        out["by_k"][f"k{k}"] = row
+    out["parity_all"] = parity_all
+    k1 = out["by_k"].get("k1", {}).get("host_ms_per_token")
+    k4 = out["by_k"].get("k4", {}).get("host_ms_per_token")
+    out["host_ms_per_token_k1"] = k1
+    out["host_ms_per_token_k4"] = k4
+    out["host_overhead_reduction_x"] = (
+        round(k1 / k4, 3) if k1 and k4 else None)
+    return out
+
+
 def _cb_chaos_bench(params, cfg, slots: int, prompt: int, new: int,
                     stride: int, page: int, reqs: int,
                     seed: int = 0) -> dict:
@@ -1616,6 +1697,11 @@ def _families_bench(cfg, params, on_tpu) -> dict:
         out["cb_tp_serving"] = _cb_tp_bench(
             qparams, cfg, slots=8, prompt=512, new=64, stride=16,
             reqs=24, page=128, iters=iters)
+        # fused multi-tick decode (ISSUE 8): same-window K sweep —
+        # host ms/token is the metric fused ticks exist to shrink
+        out["cb_fused_ticks"] = _cb_fused_bench(
+            qparams, cfg, slots=8, prompt=512, new=64, stride=16,
+            reqs=24, page=128)
     else:
         out["continuous_batching"] = _cb_ab_bench(
             qparams, cfg, slots=2, prompt=8, new=4, stride=2,
@@ -1634,6 +1720,9 @@ def _families_bench(cfg, params, on_tpu) -> dict:
             qparams, cfg, dense_slots=2, paged_slots=4,
             buckets=(8, 16), mix=[(8, 4), (8, 4), (16, 4)],
             reqs=5, stride=2, page=8, iters=iters)
+        # cb_fused_ticks rides the on_tpu branch + the bench smoke
+        # (like cb_tp_serving): the tiny tier-1 path already pays for
+        # the full fused K sweep in run_serving_bench_smoke
 
     # --- train the bench model on a cyclic pattern --------------------
     # One training pays for TWO honest speculative rows: the PLD
@@ -1939,6 +2028,9 @@ def run_serving_bench_smoke() -> dict:
         "cb_trace_overhead": _cb_trace_overhead_bench(
             params, cfg, slots=2, prompt=16, new=8, stride=2, page=8,
             reqs=6),
+        "cb_fused_ticks": _cb_fused_bench(
+            params, cfg, slots=3, prompt=16, new=24, stride=2, page=8,
+            reqs=3, ks=(1, 4)),
     }
 
 

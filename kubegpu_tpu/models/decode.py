@@ -805,6 +805,22 @@ def greedy_generate(params: dict, prompt: jax.Array, n_steps: int,
                     kv_int8=kv_int8)
 
 
+def truncate_at_eos(tokens: list, eos_id: int | None) -> bool:
+    """Trim a generated-token list IN PLACE at its first EOS
+    (inclusive, so the terminator is returned to the caller like any
+    other token).  Returns True iff an EOS was found — the serving
+    engine's finish signal, shared by its K=1 and fused consume paths
+    so both retire a request on exactly the same token."""
+    if eos_id is None:
+        return False
+    try:
+        i = tokens.index(eos_id)
+    except ValueError:
+        return False
+    del tokens[i + 1:]
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Speculative decoding (greedy, early-exit self-draft)
 # ---------------------------------------------------------------------------

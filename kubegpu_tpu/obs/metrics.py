@@ -80,6 +80,12 @@ name                            kind       meaning
 ``serve_replica_deaths``        counter    engine deaths (any cause)
 ``serve_spec_degraded``         counter    engines that fell back to
                                            γ=0 on zero-acceptance
+``serve_fused_block_ms``        histogram  host sync wall of one fused
+                                           K-tick block (ISSUE 8)
+``serve_host_overhead_pct``     gauge      share of a step's wall spent
+                                           OUTSIDE the device sync —
+                                           the cost fused ticks
+                                           amortize (ISSUE 8)
 ==============================  =========  ============================
 
 Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as

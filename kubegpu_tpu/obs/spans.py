@@ -253,6 +253,16 @@ class Tracer:
             out = [s for s in out if s.name == name]
         return out
 
+    def count(self, name: str, trace_id: str | None = None) -> int:
+        """Number of FINISHED spans with ``name`` (optionally within
+        one trace) — the cheap cardinality check the fused-decode tests
+        lean on (one ``engine.tick`` span per fused BLOCK, not per
+        device tick) without materializing span lists."""
+        with self._lock:
+            return sum(1 for s in self._spans
+                       if s.name == name
+                       and (trace_id is None or s.trace_id == trace_id))
+
     def trace_ids(self) -> list[str]:
         with self._lock:
             seen: dict[str, None] = {}

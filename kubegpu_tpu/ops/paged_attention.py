@@ -71,6 +71,15 @@ def page_table_size(max_len: int, page_size: int) -> int:
     return -(-max_len // page_size)
 
 
+def decode_capacity(n_pages: int, t_pad: int, page_size: int) -> int:
+    """Decode positions a row's allocation can hold: everything its
+    ``n_pages`` pages cover past the page-aligned prompt region
+    ``[0, t_pad)``.  The serving engine budgets fused multi-tick decode
+    against this bound — a lane that would flush past it is frozen
+    on-device instead of writing into another row's pages."""
+    return max(n_pages * page_size - t_pad, 0)
+
+
 # ---------------------------------------------------------------------------
 # XLA reference (CPU tests + parity oracle)
 # ---------------------------------------------------------------------------

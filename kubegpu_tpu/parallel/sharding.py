@@ -55,6 +55,25 @@ def device_put_tree(mesh: Mesh, tree, spec_tree):
     return jax.device_put(tree, sharding)
 
 
+def sharded_jit(f, mesh: Mesh, in_specs, out_specs, donate=()):
+    """``compat_shard_map`` + ``jax.jit`` with buffer donation, in one
+    call — the wrapping every mesh-native serving executable repeats by
+    hand (an explicit jitted def whose only job is naming the donated
+    argument).  ``donate`` names arguments of ``f`` whose buffers the
+    caller rebinds every dispatch (the page pool); jit resolves the
+    names against ``f``'s own signature through ``__wrapped__``."""
+    import functools
+
+    mapped = compat_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check=False)
+
+    @functools.wraps(f)
+    def call(*args):
+        return mapped(*args)
+
+    return jax.jit(call, donate_argnames=tuple(donate))
+
+
 def compat_shard_map(f, mesh: Mesh, in_specs, out_specs, check=False):
     """shard_map across the jax API generations this repo meets: the
     driver's image has ``jax.shard_map`` (replication checking spelled

@@ -32,6 +32,14 @@ Env knobs:
                  serve_engine_spec_accept_rate and
                  serve_engine_spec_tokens_per_tick so the harvested
                  tok/s carries the acceptance that produced it
+  SERVE_FUSED_K  continuous+paged: fused multi-tick decode — run K
+                 complete engine ticks per host round-trip (default 1;
+                 the engine drops any block back to K=1 while host
+                 work is pending: admission waves, prefill chunks,
+                 quarantine replays).  Paged-only; under strict mode a
+                 fused ask on the dense fallback aborts.  The pod
+                 echoes serve_engine_cfg_fused_k and
+                 serve_fused_dispatches
 
 The decode throughput metric subtracts a separately-timed prefill of
 the same configuration (the advisor's r2 finding: dividing by an
@@ -223,6 +231,18 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                  "engine; the dense fallback would serve the plain "
                  "one-token-per-slot path")
         spec_gamma = 0
+    # fused multi-tick decode (SERVE_FUSED_K > 1): run K complete
+    # engine ticks per host round-trip (ISSUE 8).  Paged-only — the
+    # engine itself drops any block to K=1 whenever host work (an
+    # admission wave, a prefill chunk, a quarantine replay) is
+    # pending, so the knob is a ceiling, not a promise.
+    fused_k = int(os.environ.get("SERVE_FUSED_K", "1"))
+    if fused_k > 1 and not paged:
+        from kubegpu_tpu.ops.strict import fallback
+        fallback("llama_serve.fused",
+                 f"SERVE_FUSED_K={fused_k} needs the paged engine; "
+                 "the dense fallback syncs every tick")
+        fused_k = 1
     # mesh-native serving (SERVE_TP / SERVE_DP): shard the paged engine
     # over tp chips (per-chip pools hold Hkv/tp heads) and/or run dp
     # independent replicas behind one admission queue.  Degrades to
@@ -259,6 +279,7 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                   page_size=page_size, kv_int8=kv_int8,
                   prefix_cache=prefix_cache, chunked_prefill=chunked,
                   spec_gamma=spec_gamma, draft_layers=draft_layers,
+                  fused_ticks=fused_k,
                   tracer=tracer, trace_ctx=trace_ctx)
     if paged and dp > 1:
         from kubegpu_tpu.models.serve import DataParallelServePool
@@ -327,6 +348,14 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                 # the acceptance that produced it travel together, so
                 # the scheduler/registry sees drafting quality per pod
                 ("serve_engine_cfg_spec_gamma", spec_gamma),
+                # fused-decode echo (ISSUE 8): the ceiling asked for
+                # and how many fused blocks actually ran — a harvested
+                # zero here with fused_k > 1 means the window never
+                # reached steady state
+                ("serve_engine_cfg_fused_k", fused_k),
+                ("serve_fused_dispatches",
+                 eng.fused_dispatches if hasattr(eng, "fused_dispatches")
+                 else sum(e.fused_dispatches for e in eng.replicas)),
                 ("serve_engine_cfg_draft_layers",
                  getattr(eng, "draft_layers",
                          eng.replicas[0].draft_layers

@@ -129,3 +129,25 @@ def test_serving_bench_smoke_parses_and_carries_keys():
         assert name in to["span_names"], name
     assert to["trace_overhead_us_per_tick"] < 2000
     assert to["overhead_x_raw_weather"] < 3.0
+
+    # fused multi-tick decode (ISSUE 8): the same-window K sweep must
+    # show the fused path ACTUALLY exercised, bit-exact at every K,
+    # with strictly lower per-token host overhead at K=4 than K=1 —
+    # the headline the tentpole exists to deliver.  host_ms_per_token
+    # is a host-side counter delta (step wall minus device sync), so
+    # unlike raw wall it is assertable on a loaded CPU box.
+    ft = doc["cb_fused_ticks"]
+    assert ft["protocol"] == "same_window_fused_k_sweep"
+    assert ft["parity_all"] is True
+    for k in ft["ks"]:
+        row = ft["by_k"][f"k{k}"]
+        assert row["parity_vs_k1"] is True, k
+        assert row["tokens"] == ft["requests"] * ft["new_tokens"], k
+        if k > 1:
+            assert row["fused_dispatches"] > 0, \
+                f"K={k} leg never took the fused path"
+            assert row["fused_ticks_run"] >= row["fused_dispatches"]
+    assert ft["by_k"]["k1"]["fused_dispatches"] == 0
+    assert ft["host_ms_per_token_k4"] < ft["host_ms_per_token_k1"], \
+        "fused ticks must shrink per-token host overhead"
+    assert ft["host_overhead_reduction_x"] > 1.0

@@ -242,6 +242,51 @@ class TestPagePoolFuzz:
         assert done[0].tokens == ref["b"]
         check_pool_invariants(eng)
 
+    def test_fused_budget_churn_invariants(self, tiny):
+        """ISSUE 8: the fused engine advances page tables ON DEVICE for
+        K ticks between host reconciliations — 100 random events over a
+        fused_ticks=4 engine must keep every allocator truth intact at
+        every reconciliation point, and a pool sized near exhaustion
+        must still drain (budget freeze + stall flag, not overcommit)."""
+        cfg, params = tiny
+        rng = np.random.default_rng(9)
+        eng = make_engine(cfg, params, fused_ticks=4)
+        want: dict[int, int] = {}
+        done: dict[int, int] = {}
+        for _ in range(100):
+            if rng.random() < 0.5 and len(eng.queue) < 4:
+                plen = int(rng.integers(1, 16))
+                new = int(rng.integers(1, 9))
+                rid = eng.submit(
+                    rng.integers(0, cfg.vocab_size, plen), new)
+                want[rid] = new
+            for r in eng.step():
+                done[r.rid] = len(r.tokens)
+            check_pool_invariants(eng)
+        for r in eng.drain():
+            done[r.rid] = len(r.tokens)
+        check_pool_invariants(eng)
+        assert not eng._slot_pages
+        assert len(eng._free_pages) == eng.total_pages
+        assert done == want
+        assert eng.fused_dispatches > 0, "fused path must have run"
+
+    def test_fused_near_exhaustion_forward_progress(self, tiny):
+        """Fused blocks must respect the page budget pre-computed at
+        admission: with pages for only one request at a time, a 5-deep
+        queue still drains completely under fused_ticks=4."""
+        cfg, params = tiny
+        eng = make_engine(cfg, params, total_pages=2, fused_ticks=4)
+        rids = [eng.submit(np.arange(1, 6), 4) for _ in range(5)]
+        finished, steps = [], 0
+        while (eng.queue or eng.slot_req) and steps < 200:
+            finished.extend(eng.step())
+            assert len(eng._slot_pages) <= 1
+            check_pool_invariants(eng)
+            steps += 1
+        assert sorted(r.rid for r in finished) == rids
+        assert len(eng._free_pages) == 2
+
 
 class TestSpeculativeRollback:
     """Rollback invariants of the speculative verify tick (ISSUE 3):

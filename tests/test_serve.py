@@ -868,3 +868,109 @@ class TestSpeculativeEngine:
             ContinuousBatcher(mparams, mcfg, n_slots=1,
                               prompt_buckets=(8,), paged=True,
                               page_size=8, spec_gamma=2)
+
+
+class TestFusedDecode:
+    """Fused multi-tick decode (ISSUE 8 tentpole): K complete engine
+    ticks — paged attention, sampling, flush, on-device table/slot
+    advance, EOS/budget/quarantine flags — run inside one ``lax.scan``
+    and come home in ONE host fetch.  Contract: greedy bit-exact vs
+    the K=1 engine (and solo) under every fast path the engine has,
+    with the fused path PROVABLY exercised (``fused_dispatches > 0``).
+    Engine geometry deliberately matches TestSpeculativeEngine
+    (n_slots=3, stride=4, same tiny4 config) so every K=1 leg reuses
+    already-compiled executables — only the fused entries pay XLA."""
+
+    @pytest.fixture(scope="class")
+    def tiny4(self):
+        cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, max_seq_len=64)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def _eng(self, params, cfg, tp=1, **kw):
+        from kubegpu_tpu.models.serve import make_serve_mesh
+        kw.setdefault("n_slots", 3)
+        kw.setdefault("stride", 4)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("paged", True)
+        kw.setdefault("page_size", 8)
+        return ContinuousBatcher(
+            params, cfg, mesh=make_serve_mesh(tp) if tp > 1 else None,
+            **kw)
+
+    def _drain(self, eng, prompts):
+        rids = [eng.submit(p, n) for p, n in prompts]
+        done = {r.rid: r.tokens for r in eng.drain()}
+        return [done[r] for r in rids]
+
+    def test_fused_k4_bit_exact_vs_k1_solo_and_eos(self, tiny4):
+        """The headline contract on a plain paged window: K=4 emits
+        token-for-token what K=1 and solo greedy emit, while actually
+        running SEVERAL fused blocks (so mid-stream reconciliation —
+        retire, page release — happens between blocks).  Rides the
+        same window for EOS parity: an on-device EOS hit freezes a
+        lane mid-block, and host truncation must agree bit-exactly
+        with K=1's per-tick EOS handling."""
+        cfg, params = tiny4
+        prompts = [([(i * 7 + 3) % cfg.vocab_size
+                     for i in range(5 + 3 * j)], 25) for j in range(3)]
+        k1 = self._drain(self._eng(params, cfg), prompts)
+        eng4 = self._eng(params, cfg, fused_ticks=4)
+        k4 = self._drain(eng4, prompts)
+        assert k4 == k1
+        assert eng4.fused_dispatches > 1, \
+            "window must span several fused blocks"
+        assert eng4.fused_ticks_run >= 2 * eng4.fused_dispatches
+        for (p, n), toks in zip(prompts, k1):
+            assert toks == solo(params, p, n, cfg)
+        # EOS legs on the same window: a token K=1 provably emits
+        # mid-run becomes the stop token for both engines
+        eos = k1[0][len(k1[0]) // 2]
+        e1 = self._drain(self._eng(params, cfg, eos_id=eos), prompts)
+        e4 = self._drain(
+            self._eng(params, cfg, fused_ticks=4, eos_id=eos), prompts)
+        assert e4 == e1
+        assert len(e1[0]) < len(k1[0]), "EOS must truncate the run"
+        assert e1[0][-1] == eos
+
+    def test_fused_full_stack_parity(self, tiny4):
+        """The acceptance bar: fused K=4 composes with prefix caching
+        + chunked prefill + speculative decoding (γ=3) + tp=2, bit-
+        exact vs the same stack at K=1 — and each fast path must
+        actually engage."""
+        cfg, params = tiny4
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        shared = [(i * 5 + 3) % cfg.vocab_size for i in range(8)]
+        prompts = [(shared + [(41 + 9 * j + i) % cfg.vocab_size
+                              for i in range(5)], 9) for j in range(3)]
+        runs = {}
+        for k in (1, 4):
+            eng = self._eng(params, cfg, tp=2, prefix_cache=True,
+                            chunked_prefill=True, prefill_chunk=8,
+                            spec_gamma=3, draft_layers=1,
+                            fused_ticks=k)
+            # stagger arrivals: the first request's prefix pages must
+            # be cached before the sharing requests are admitted
+            rids, done = [], {}
+            (p0, n0) = prompts[0]
+            rids.append(eng.submit(p0, n0))
+            for _ in range(3):
+                done.update({r.rid: r.tokens for r in eng.step()})
+            rids += [eng.submit(p, n) for p, n in prompts[1:]]
+            done.update({r.rid: r.tokens for r in eng.drain()})
+            runs[k] = [done[r] for r in rids]
+            if k > 1:
+                assert eng.fused_dispatches > 0, \
+                    "fused spec path must actually run"
+                assert eng.spec_ticks > 0
+                assert eng.prefix_hits >= 1 and eng.chunks_run >= 1
+        assert runs[4] == runs[1]
+
+    def test_fused_validation(self, tiny4):
+        cfg, params = tiny4
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(params, cfg, n_slots=1,
+                              prompt_buckets=(8,), fused_ticks=4)
+        with pytest.raises(ValueError, match="fused_ticks"):
+            self._eng(params, cfg, fused_ticks=0)

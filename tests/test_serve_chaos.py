@@ -435,3 +435,78 @@ class TestBindConflictRetry:
             assert sched.metrics.counter("bind_conflict_requeued") == 1
         finally:
             cl.close()
+
+
+class TestFusedChaos:
+    """Fused multi-tick decode under fault injection (ISSUE 8): a
+    quarantine flag raised MID-BLOCK on the device comes home in the
+    same fused fetch, truncates that lane's emissions at the poisoned
+    tick, and replays bit-exact; replica kill during fused serving
+    fails over with the same exactly-once/bit-exact contract.  Windows
+    are sized so several fused blocks run (chaos fires at dispatch
+    gates — a window that drains in one block never reaches its
+    event)."""
+
+    def _eng(self, params, cfg, **kw):
+        kw.setdefault("n_slots", 2)
+        kw.setdefault("stride", 2)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("paged", True)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("fused_ticks", 4)
+        return ContinuousBatcher(params, cfg, **kw)
+
+    def test_mid_block_nan_quarantine_replays_bit_exact(self, tiny):
+        """The poison lands on an inner tick of a fused block: the
+        on-device bad flag must freeze the lane inside the scan, the
+        host must discard that lane's tokens from the poisoned tick on,
+        and the replay must reproduce the fault-free stream exactly —
+        while the neighbor slot sails through untouched."""
+        cfg, params = tiny
+        reg = MetricsRegistry()
+        eng = self._eng(params, cfg, metrics=reg, chaos=ChaosInjector(
+            [ChaosEvent(tick=2, kind="nan_logits")]))
+        prompts = [([(i * 3 + 1) % cfg.vocab_size for i in range(5)], 20),
+                   ([(i * 5 + 2) % cfg.vocab_size for i in range(7)], 20)]
+        rids = {eng.submit(p, n): (p, n) for p, n in prompts}
+        seen = {}
+        for r in eng.drain():
+            assert r.rid not in seen, "duplicate completion"
+            seen[r.rid] = r
+        assert set(seen) == set(rids)
+        assert eng.fused_dispatches > 1, \
+            "the fault must land inside fused serving"
+        assert eng.slots_quarantined == 1
+        assert eng.requests_retried == 1
+        assert reg.counter("serve_slots_quarantined") == 1
+        for rid, (p, n) in rids.items():
+            assert seen[rid].error is None
+            assert seen[rid].tokens == solo(params, p, n, cfg), rid
+
+    def test_replica_kill_during_fused_serving(self, tiny):
+        """dp=2 pool of fused engines, one replica killed mid-stream:
+        failover replays every orphaned request bit-exact on the
+        survivor — the fused fetch layout must not confuse the replay
+        bookkeeping."""
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        pool = DataParallelServePool(
+            params, cfg, dp=2, tp=1, n_slots=2, stride=2,
+            prompt_buckets=(8, 16), page_size=8, fused_ticks=4,
+            chaos={1: ChaosInjector(
+                [ChaosEvent(tick=2, kind="kill_replica")])})
+        prompts = [(p, 20) for p, _ in mixed_prompts(cfg, n=4)]
+        rids = {pool.submit(p, n): (p, n) for p, n in prompts}
+        seen = {}
+        for r in pool.drain():
+            assert r.rid not in seen, f"rid {r.rid} completed twice"
+            seen[r.rid] = r
+        assert set(seen) == set(rids), "request lost"
+        assert pool.failovers == 1
+        assert 1 in pool.dead_replicas
+        assert sum(e.fused_dispatches for e in pool.replicas
+                   if e is not None) > 0
+        for rid, (p, n) in rids.items():
+            assert seen[rid].error is None, (rid, seen[rid].error)
+            assert seen[rid].tokens == solo(params, p, n, cfg), rid
