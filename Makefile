@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
-        fused-smoke clean
+        fused-smoke analyze clean
 
 all: native
 
@@ -22,7 +22,13 @@ test:
 bench:
 	$(PY) bench.py
 
-bench-smoke:                    # serving bench legs at tiny CPU configs
+analyze:                        # KTP-Audit (ISSUE 9): AST lints +
+	# jaxpr audit + compile-signature census over the serving hot
+	# path.  Exit nonzero on any unblessed violation; blessed sites
+	# are reported (not hidden) so the allowlist stays reviewable.
+	JAX_PLATFORMS=cpu $(PY) -m kubegpu_tpu.analysis
+
+bench-smoke: analyze            # serving bench legs at tiny CPU configs
 	# 8 virtual devices so the sharded-serving leg (tp=1/2/4 + the
 	# equal-chip tp-vs-dp A/B) runs for real, not as skip rows
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
@@ -35,7 +41,7 @@ chaos-smoke:                    # seeded chaos scenario matrix (ISSUE 4):
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_chaos.py -q
 
-fused-smoke:                    # ISSUE 8 fused multi-tick decode: K=4
+fused-smoke: analyze            # ISSUE 8 fused multi-tick decode: K=4
 	# bit-exact vs K=1 under prefix cache + chunked prefill + spec +
 	# tp=2, page-pool invariants under fused-budget churn, mid-block
 	# quarantine replay, and the cb_fused_ticks host-overhead gate.

@@ -517,7 +517,7 @@ int32_t ktpu_align_units(const int32_t* opts_data, const int32_t* n_opts,
 // Connected-region fallback search (gang.py _connected_candidate): from
 // each free coord in lexicographic order, grow a connected set of free
 // chips with a sorted-frontier BFS (a min-heap keyed on coord — identical
-// pop order to the Python frontier.sort(); pop(0)), then chunk it
+// pop order to the Python heapq frontier), then chunk it
 // host-locally (pods take chips_per_pod chips host by host, hosts in id
 // order).  Returns 0 + the first start whose chunked order covers `total`
 // chips in exactly `num_pods` chunks, 1 when no start works, -1 on bad

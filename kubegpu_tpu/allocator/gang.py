@@ -17,6 +17,7 @@ Fractional requests (millitpu < 1000) bin-pack onto partially-used chips
 
 from __future__ import annotations
 
+import heapq
 import os
 from dataclasses import dataclass, field
 
@@ -827,16 +828,18 @@ class GangAllocator:
             seen = {start}
             frontier = [start]
             region: list[Coord] = []
+            # min-heap pop == the old frontier.sort(); pop(0) order
+            # (smallest coord each iteration) at O(log n) per pop —
+            # the native port's sorted-frontier BFS matches this too
             while frontier and len(region) + len(frontier) <= len(free):
-                frontier.sort()
-                nxt = frontier.pop(0)
+                nxt = heapq.heappop(frontier)
                 region.append(nxt)
                 if len(region) >= total:
                     break
                 for nb in st.topo.neighbors(nxt):
                     if nb not in seen and nb not in blocked:
                         seen.add(nb)
-                        frontier.append(nb)
+                        heapq.heappush(frontier, nb)
             if len(region) < total:
                 continue
             # chunk host-locally: pods take chips host by host

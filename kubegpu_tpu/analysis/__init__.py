@@ -1,0 +1,118 @@
+"""KTP-Audit: static analysis that guards the serving hot path.
+
+Two prongs, one CLI (``python -m kubegpu_tpu.analysis``, or ``make
+analyze``); both emit human + JSON reports and exit nonzero on any
+unblessed violation — the repo itself must pass clean.
+
+**Prong 1 — jaxpr/HLO auditor** (:mod:`.jaxpr_audit`): lowers every
+serving executable (``decode_block``, ``decode_fused``,
+``verify_block``/``verify_fused``, ``prefill_wave``,
+``prefill_chunk``, ``adopt_wave``, ``activate_slot``) from a
+tiny-config engine on representative abstract shapes and walks the
+jaxpr to prove:
+
+- **JXA001 — zero host callbacks**: no ``pure_callback`` /
+  ``io_callback`` / ``debug_callback`` primitive anywhere in a
+  serving executable (one stray ``jax.debug.print`` is a host round
+  trip per tick — the exact host-overhead wall PR 8's fused ticks
+  paid down).
+- **JXA002 — no silent f32 upcasts** in the bf16/int8 attention
+  paths: a per-eqn dtype census flags every
+  ``convert_element_type`` from bf16/f16/int8 to f32 unless the
+  source function is on the explicit accumulator allowlist
+  (rmsnorm / rope / logits-at-selection — ``[[jaxpr.upcast]]`` in
+  ``blessed_sites.toml``).
+- **CEN001 — compile-signature census**: a scripted workload
+  (admission wave → chunked prefill → spec ticks → fused K∈{1,4} →
+  quarantine replay) drives ``ContinuousBatcher`` end to end while a
+  shim records the lowering signature of every dispatch; the distinct
+  set must equal the expected set enumerated in
+  :func:`.jaxpr_audit.expected_signatures` — any new signature is a
+  recompilation hazard, reported with the offending shape diff.
+
+**Prong 2 — AST lint engine** (:mod:`.lint`): repo-specific rules
+with stable codes over all of ``kubegpu_tpu/``:
+
+=======  =============================================================
+code     rule (rationale / how to bless)
+=======  =============================================================
+KTP001   ``list.pop(0)`` — O(n) shift per pop on hot paths; use
+         ``collections.deque`` (or ``heapq`` for sorted pops).  Bless
+         with an inline ``# ktp: allow(KTP001) reason`` pin when the
+         list is provably tiny and bounded.
+KTP002   implicit host sync in the device-code layers (``models/``,
+         ``ops/``, ``parallel/``): ``np.asarray`` / ``np.array`` /
+         ``.item()`` / ``jax.device_get`` / ``float|int|bool(jnp…)``.
+         Every fetch outside the blessed gates (``_collect``,
+         ``_consume_fused``'s input, warmup's compile barrier) is a
+         hidden device round trip.  Bless in
+         ``blessed_sites.toml`` ``[[bless]]`` with file+func+reason.
+KTP003   unseeded RNG / wall-clock read inside a TRACED function
+         (jitted, shard_mapped, or scanned): the value is frozen at
+         trace time.  Thread keys / timestamps in as arguments.
+KTP004   every metric/span name observed in code must appear in the
+         ``obs/metrics.py`` METRICS TABLE (the documented-name
+         registry, :func:`kubegpu_tpu.obs.metrics.documented_names`).
+         "Bless" by adding the missing table row — that IS the fix.
+KTP005   unbounded list/dict growth in long-lived engine / pool /
+         tracer / registry classes: appended per event with no
+         eviction anywhere in the class.  Fix with
+         ``deque(maxlen=…)`` or an eviction sweep — passing the
+         attribute to a ``*trim*``/``*prune*``/``*evict*``/
+         ``*drain*`` helper counts; bless only with a lifetime
+         argument (object dies with the request window).
+KTP006   attribute written under the class lock in one method but
+         bare in another, in a ``threading``-importing module — an
+         inconsistently-locked write is a data race.  Methods named
+         ``*_locked`` are caller-holds-lock by convention and count
+         as locked.  Bless with the single-writer argument if one
+         thread provably owns it.
+=======  =============================================================
+
+How to bless a site: prefer a ``[[bless]]`` entry in
+``analysis/blessed_sites.toml`` (rule + file + func + reason) for
+standing architectural gates; use an inline
+``# ktp: allow(KTPxxx) reason`` comment pin for one-off sites where
+the justification should sit next to the code.  Blessed findings
+still appear in the JSON report under ``"blessed"`` so reviews can
+audit the allowlist itself.
+
+The README's "Static analysis" section mirrors this table.
+"""
+
+from .jaxpr_audit import (audit_engine_executables, compile_census,
+                          expected_signatures)
+from .lint import RULES, lint_package
+from .report import Finding, Report
+
+__all__ = [
+    "Finding", "Report", "RULES", "lint_package",
+    "audit_engine_executables", "compile_census",
+    "expected_signatures", "run_all",
+]
+
+
+def run_all(root=None, census: bool = True) -> Report:
+    """Run both prongs; the CLI's single entry point.
+
+    ``root`` defaults to the installed ``kubegpu_tpu`` package dir;
+    ``census=False`` skips the compile-signature census (the slowest
+    pass — it compiles the tiny engine's executables for real)."""
+    import pathlib
+
+    from .blessed import Blessings
+
+    if root is None:
+        root = pathlib.Path(__file__).resolve().parent.parent
+    root = pathlib.Path(root)
+    blessings = Blessings.load()
+    report = Report()
+    report.extend(lint_package(root, blessings))
+    audit_findings, audit_summary = audit_engine_executables(blessings)
+    report.extend(audit_findings)
+    report.summaries["jaxpr_audit"] = audit_summary
+    if census:
+        census_findings, census_summary = compile_census()
+        report.extend(census_findings)
+        report.summaries["compile_census"] = census_summary
+    return report

@@ -1,0 +1,57 @@
+"""CLI: ``python -m kubegpu_tpu.analysis [--json] [--no-census]
+[--lint-only] [--root DIR]``.
+
+Exit status 0 when the repo is clean (blessed findings do not fail the
+run — they are reported under ``"blessed"`` so the allowlist itself
+stays reviewable), 1 when any unblessed violation is found, 2 on
+usage errors.  ``make analyze`` is the canonical invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubegpu_tpu.analysis",
+        description="KTP-Audit: jaxpr auditor + repo lint engine")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable JSON report")
+    ap.add_argument("--no-census", action="store_true",
+                    help="skip the compile-signature census (the only "
+                         "pass that compiles; the rest just trace)")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="AST lints only — no jax import, no tracing")
+    ap.add_argument("--root", default=None,
+                    help="package root to lint (default: the installed "
+                         "kubegpu_tpu package)")
+    args = ap.parse_args(argv)
+
+    if args.lint_only:
+        import pathlib
+
+        from .blessed import Blessings
+        from .lint import lint_package
+        from .report import Report
+        root = pathlib.Path(args.root) if args.root else \
+            pathlib.Path(__file__).resolve().parent.parent
+        report = Report()
+        report.extend(lint_package(root, Blessings.load()))
+    else:
+        from . import run_all
+        report = run_all(root=args.root, census=not args.no_census)
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+"""Blessed-site allowlist: ``analysis/blessed_sites.toml`` + inline
+comment pins.
+
+Two ways to bless a site the linter or jaxpr auditor flags:
+
+1. A TOML entry (reviewed, carries a reason — preferred for standing
+   architectural gates like the engine's single host-fetch point)::
+
+       [[bless]]
+       rule = "KTP002"
+       file = "kubegpu_tpu/models/serve.py"
+       func = "ContinuousBatcher._collect"
+       reason = "THE host sync — the engine's one designed fetch gate"
+
+   ``func`` matches the qualified name of the ENCLOSING function
+   (suffix match, so ``_collect`` also works); omit it to bless a
+   whole file for that rule (used sparingly).
+
+2. An inline comment pin on the flagged line (or the line above) —
+   for one-off sites where the TOML indirection would hide the
+   justification from the reader::
+
+       free.pop(0)   # ktp: allow(KTP001) bounded n_slots scan
+
+Jaxpr-audit upcast allowlisting uses the ``[[jaxpr.upcast]]`` tables:
+``func`` is the function name jax's source info attributes the
+``convert_element_type`` to (e.g. ``_rmsnorm``).
+
+The loader prefers stdlib ``tomllib`` (3.11+), falls back to ``tomli``,
+and finally to a minimal line parser that understands exactly the
+subset this file uses — the container must never need a new dep.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+
+def _parse_toml(text: str) -> dict:
+    try:
+        import tomllib
+        return tomllib.loads(text)
+    except ImportError:
+        pass
+    try:
+        import tomli
+        return tomli.loads(text)
+    except ImportError:
+        pass
+    return _parse_minimal(text)
+
+
+def _parse_minimal(text: str) -> dict:
+    """Fallback parser for the restricted shape blessed_sites.toml
+    uses: ``[[dotted.table]]`` array-of-table headers and
+    ``key = "string"`` entries.  No nesting beyond the header path, no
+    non-string values — by construction of the file it parses."""
+    doc: dict = {}
+    current: dict | None = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.fullmatch(r"\[\[([A-Za-z0-9_.]+)\]\]", line)
+        if m:
+            node = doc
+            parts = m.group(1).split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            current = {}
+            node.setdefault(parts[-1], []).append(current)
+            continue
+        m = re.fullmatch(r'([A-Za-z0-9_]+)\s*=\s*"((?:[^"\\]|\\.)*)"',
+                         line)
+        if m and current is not None:
+            current[m.group(1)] = m.group(2).replace('\\"', '"')
+            continue
+        raise ValueError(
+            f"blessed_sites.toml line not understood by the fallback "
+            f"parser (install tomli or simplify the entry): {raw!r}")
+    return doc
+
+
+_DEFAULT_PATH = pathlib.Path(__file__).with_name("blessed_sites.toml")
+
+# inline pin: `# ktp: allow(KTP001) optional reason`
+_INLINE_RE = re.compile(r"#\s*ktp:\s*allow\((KTP\d{3}|JXA\d{3})\)\s*(.*)")
+
+
+class Blessings:
+    """Allowlist lookups for both prongs."""
+
+    def __init__(self, doc: dict):
+        self._lint = doc.get("bless", []) or []
+        jaxpr = doc.get("jaxpr", {}) or {}
+        self._upcast = jaxpr.get("upcast", []) or []
+        self._callback = jaxpr.get("callback", []) or []
+
+    @classmethod
+    def load(cls, path: pathlib.Path | None = None) -> "Blessings":
+        p = path or _DEFAULT_PATH
+        if not p.exists():
+            return cls({})
+        return cls(_parse_toml(p.read_text()))
+
+    def lint_reason(self, rule: str, relpath: str,
+                    qualname: str) -> str | None:
+        """TOML blessing for a lint finding; returns the reason or
+        None.  ``qualname`` is the enclosing function's dotted name
+        ("" at module level)."""
+        rel = relpath.replace("\\", "/")
+        for e in self._lint:
+            if e.get("rule") != rule:
+                continue
+            if e.get("file") and not rel.endswith(e["file"]):
+                continue
+            func = e.get("func")
+            if func and not (qualname == func
+                             or qualname.endswith("." + func)
+                             or qualname.split(".")[-1] == func):
+                continue
+            if not e.get("file") and not func:
+                continue
+            return e.get("reason", "blessed")
+        return None
+
+    def upcast_reason(self, file: str, func: str) -> str | None:
+        """Jaxpr-audit blessing for an intentional f32 upcast,
+        matched on the source function jax attributes the convert to."""
+        f = file.replace("\\", "/")
+        for e in self._upcast:
+            if e.get("func") and e["func"] != func:
+                continue
+            if e.get("file") and not f.endswith(e["file"]):
+                continue
+            if not e.get("func") and not e.get("file"):
+                continue
+            return e.get("reason", "blessed")
+        return None
+
+    def callback_reason(self, file: str, func: str) -> str | None:
+        f = file.replace("\\", "/")
+        for e in self._callback:
+            if e.get("func") and e["func"] != func:
+                continue
+            if e.get("file") and not f.endswith(e["file"]):
+                continue
+            if not e.get("func") and not e.get("file"):
+                continue
+            return e.get("reason", "blessed")
+        return None
+
+
+def inline_allow(src_lines: list[str], line: int,
+                 rule: str) -> str | None:
+    """Inline comment pin on the flagged line or the line above.
+    ``line`` is 1-indexed."""
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(src_lines):
+            m = _INLINE_RE.search(src_lines[ln - 1])
+            if m and m.group(1) == rule:
+                return m.group(2).strip() or "inline pin"
+    return None

@@ -1,0 +1,491 @@
+"""Prong 1 — the jaxpr/HLO auditor.
+
+Lowers every serving executable from a tiny-config
+:class:`~kubegpu_tpu.models.serve.ContinuousBatcher` on representative
+shapes (mirroring ``warmup()``'s argument construction) and walks the
+jaxpr recursively — through ``pjit`` / ``scan`` / ``cond`` /
+``pallas_call`` sub-jaxprs — to prove three properties:
+
+- **JXA001**: zero host callbacks (``pure_callback`` / ``io_callback``
+  / ``debug_callback``) anywhere in a serving executable.  One stray
+  ``jax.debug.print`` is a host round trip per tick — the exact wall
+  PR 8's fused multi-tick decode paid down.
+- **JXA002**: no silent f32 upcasts in the bf16/int8 attention paths.
+  Every ``convert_element_type`` from {bf16, f16, int8} to f32 must be
+  attributable to a function on the ``[[jaxpr.upcast]]`` allowlist in
+  ``blessed_sites.toml`` (lse/softmax/norm accumulators and
+  logits-at-selection are upcast ON PURPOSE; anything else is a
+  perf bug hiding in plain sight).
+- **CEN001**: the compile-signature census.  A scripted workload
+  (admission wave → chunked prefill → spec ticks → fused K∈{1,4} →
+  quarantine replay) drives two engines end to end while a shim over
+  ``eng._fns`` records the lowering signature of every dispatch; the
+  distinct set must EQUAL :func:`expected_signatures` — a signature
+  outside the set is a recompilation hazard (reported with the
+  offending shape diff), a missing one means the workload drifted and
+  the census lost coverage.
+
+All three run on CPU (``JAX_PLATFORMS=cpu``); the audit prong only
+traces (``jax.make_jaxpr`` — no compile), the census compiles the tiny
+engine for real and doubles as the ``cb_compile_census`` bench row
+(signature count + first-compile ms per executable).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from collections import defaultdict
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from .blessed import Blessings
+from .report import Finding
+
+# _fns tuple order, fixed by _paged_engine_fns / _engine_fns.
+EXECUTABLES = ("decode_block", "prefill_wave", "adopt_wave",
+               "prefill_chunk", "activate_slot", "verify_block",
+               "decode_fused", "verify_fused")
+
+# dtypes whose widening to f32 the census must account for
+_NARROW = ("bfloat16", "float16", "int8")
+
+
+# --------------------------------------------------------------- walk
+
+def _subjaxprs(v):
+    from jax.core import ClosedJaxpr, Jaxpr
+    if isinstance(v, ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, Jaxpr):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _subjaxprs(x)
+
+
+def walk_jaxpr(jaxpr, visit) -> None:
+    """Depth-first over every eqn, descending into sub-jaxprs found in
+    eqn params (pjit bodies, scan/while carries, cond branches,
+    pallas_call kernels)."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for v in eqn.params.values():
+            for sub in _subjaxprs(v):
+                walk_jaxpr(sub, visit)
+
+
+def _frame_of(eqn):
+    """(file, line, func) jax attributes the eqn to, best effort."""
+    try:
+        import jax._src.source_info_util as siu
+        f = siu.user_frame(eqn.source_info)
+        if f is not None:
+            return f.file_name, f.start_line, f.function_name
+    except Exception:
+        pass
+    return None, 0, ""
+
+
+# -------------------------------------------------------- audit prong
+
+def audit_jaxpr(fn, args, name: str, blessings: Blessings,
+                static_kwargs: dict | None = None):
+    """Trace one executable and audit its jaxpr.
+
+    Returns ``(findings, stats)``; findings carry JXA001 (host
+    callback) and JXA002 (unblessed narrow→f32 upcast), stats count
+    eqns / callbacks / upcasts for the summary.  Also usable on
+    deliberately-bad fixtures in tests."""
+    import jax
+    if static_kwargs:
+        fn = functools.partial(fn, **static_kwargs)
+    jx = jax.make_jaxpr(fn)(*args)
+
+    findings: list[Finding] = []
+    stats = {"eqns": 0, "callbacks": 0, "upcasts": 0,
+             "blessed_upcasts": 0}
+    seen_sites: set = set()
+
+    def visit(eqn):
+        stats["eqns"] += 1
+        pname = eqn.primitive.name
+        if "callback" in pname:
+            stats["callbacks"] += 1
+            file, line, func = _frame_of(eqn)
+            reason = blessings.callback_reason(file or "", func)
+            findings.append(Finding(
+                code="JXA001", path=file or f"<{name}>", line=line,
+                message=(f"host callback `{pname}` inside serving "
+                         f"executable `{name}` (one host round trip "
+                         f"per dispatch)"),
+                blessed=reason is not None, reason=reason))
+            return
+        if pname != "convert_element_type":
+            return
+        try:
+            src = str(eqn.invars[0].aval.dtype)
+        except AttributeError:
+            return
+        dst = str(eqn.params.get("new_dtype"))
+        if src not in _NARROW or dst != "float32":
+            return
+        file, line, func = _frame_of(eqn)
+        site = (file, line, src)
+        if site in seen_sites:   # one finding per source site, not per eqn
+            return
+        seen_sites.add(site)
+        stats["upcasts"] += 1
+        reason = blessings.upcast_reason(file or "", func)
+        if reason is not None:
+            stats["blessed_upcasts"] += 1
+        findings.append(Finding(
+            code="JXA002", path=file or f"<{name}>", line=line,
+            message=(f"silent {src}→f32 upcast in `{name}` "
+                     f"(attributed to `{func or '?'}`) — widen on the "
+                     f"accumulator allowlist or keep the math narrow"),
+            blessed=reason is not None, reason=reason))
+
+    walk_jaxpr(jx.jaxpr, visit)
+    return findings, stats
+
+
+# ------------------------------------------- tiny engines + rep. args
+
+# One shape vocabulary for both the audit and the census; tests and
+# expected_signatures() key off these exact numbers.
+AUDIT_SHAPE = dict(n_slots=2, stride=2, prompt_buckets=(8, 16),
+                   paged=True, page_size=8, prefix_cache=True,
+                   chunked_prefill=True, prefill_chunk=8,
+                   fused_ticks=4)
+
+
+def build_audit_engine(*, spec: bool = False, kv_int8: bool = False):
+    import jax
+    from kubegpu_tpu.models import LlamaConfig, llama_init
+    from kubegpu_tpu.models.serve import ContinuousBatcher
+    cfg = LlamaConfig.tiny(max_seq_len=64, dtype="bfloat16")
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    kw = dict(AUDIT_SHAPE)
+    if spec:
+        kw.update(spec_gamma=2, draft_layers=1)
+    if kv_int8:
+        kw.update(kv_int8=True)
+    return ContinuousBatcher(params, cfg, **kw)
+
+
+def representative_args(eng) -> dict:
+    """Per-executable argument tuples mirroring ``warmup()``'s
+    construction — enough to trace, not to run."""
+    import jax.numpy as jnp
+    from kubegpu_tpu.models.serve import init_kv_cache
+    B = eng.n_slots
+    key = eng._base_key
+    zb = jnp.zeros((B,), jnp.int32)
+    zf = jnp.zeros((B,), jnp.float32)
+    zpt = jnp.zeros((B, eng.max_pages), jnp.int32)
+    act = jnp.zeros((B,), bool)
+    k, bucket = 1, eng.prompt_buckets[0]
+    padded = jnp.zeros((k, bucket), jnp.int32)
+    lens = jnp.ones((k,), jnp.int32)
+    temps = jnp.zeros((k,), jnp.float32)
+    cache_w = init_kv_cache(eng.cfg, k, bucket)
+    page_dst = jnp.zeros((k, bucket // eng.page_size), jnp.int32)
+    ck = jnp.zeros((1, eng.prefill_chunk), jnp.int32)
+    ptr = jnp.zeros((1, eng.max_pages), jnp.int32)
+    sets = {
+        "decode_block": ((eng.params, eng.pool, zpt, zb, zb, zb, zb,
+                          act, zf, key, jnp.int32(0)), None),
+        "prefill_wave": ((eng.params, padded, lens, temps, key,
+                          jnp.int32(0)), None),
+        "adopt_wave": ((eng.pool, cache_w, page_dst,
+                        jnp.arange(k, dtype=jnp.int32),
+                        jnp.zeros((k,), jnp.int32), lens, temps,
+                        zb, zb, zb, zf), {"k": k}),
+        "prefill_chunk": ((eng.params, eng.pool, ck, ptr, jnp.int32(0),
+                           jnp.ones((1,), jnp.int32),
+                           jnp.zeros((1,), jnp.float32), key,
+                           jnp.int32(0)), None),
+        "activate_slot": ((zb, zb, zb, zf, jnp.int32(0),
+                           jnp.zeros((1,), jnp.int32),
+                           jnp.ones((1,), jnp.int32),
+                           jnp.zeros((1,), jnp.float32)), None),
+        "decode_fused": ((eng.params, eng.pool, zpt, zb, zb, zb, zb,
+                          act, zf, zb, zb, key, jnp.int32(0)), None),
+    }
+    if eng._fns[5] is not None:
+        import jax.numpy as jnp
+        gcap = jnp.asarray(eng._gcap)
+        sets["verify_block"] = ((eng.params, eng._draft_params,
+                                 eng.pool, zpt, zb, zb, zb, zb, act,
+                                 gcap), None)
+        if eng._fns[7] is not None:
+            sets["verify_fused"] = ((eng.params, eng._draft_params,
+                                     eng.pool, zpt, zb, zb, zb, zb,
+                                     act, zb, zb, gcap), None)
+    return sets
+
+
+def audit_engine_executables(blessings: Blessings | None = None):
+    """Trace + audit every executable of the audit engines (a
+    bf16 spec engine covers all eight executables; a kv_int8 engine
+    re-covers the quantized attention path).  Returns
+    ``(findings, summary)``."""
+    blessings = blessings or Blessings.load()
+    findings: list[Finding] = []
+    summary: dict = {"executables": {}}
+    engines = (("bf16", build_audit_engine(spec=True)),
+               ("int8", build_audit_engine(kv_int8=True)))
+    for label, eng in engines:
+        argsets = representative_args(eng)
+        for i, name in enumerate(EXECUTABLES):
+            fn = eng._fns[i]
+            if fn is None or name not in argsets:
+                continue
+            args, kw = argsets[name]
+            f, stats = audit_jaxpr(fn, args, name, blessings,
+                                   static_kwargs=kw)
+            findings.extend(f)
+            summary["executables"][f"{label}:{name}"] = stats
+    summary["total_eqns"] = sum(
+        s["eqns"] for s in summary["executables"].values())
+    return findings, summary
+
+
+# ------------------------------------------------------------- census
+
+def _sig_of(name: str, args, kwargs) -> str:
+    """The lowering signature of one dispatch: executable name +
+    dtype[shape] of every top-level array argument (param/pool/cache
+    pytrees are fixed per engine and elided) + static scalars."""
+    parts = []
+    for a in args:
+        if isinstance(a, dict):
+            continue
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            shp = "x".join(str(d) for d in a.shape)
+            parts.append(f"{a.dtype.name}[{shp}]")
+        elif isinstance(a, (bool, int, float, str)):
+            parts.append(repr(a))
+    for kname in sorted(kwargs):
+        parts.append(f"{kname}={kwargs[kname]!r}")
+    return f"{name}({','.join(parts)})"
+
+
+class _CensusShim:
+    """Wraps ``eng._fns`` so every dispatch records its lowering
+    signature; a first-seen signature is timed through
+    ``block_until_ready`` — that wall IS the first-compile cost."""
+
+    def __init__(self, eng):
+        self.first_ms: dict[str, float] = {}
+        self.by_name: dict[str, set] = defaultdict(set)
+        wrapped = []
+        for name, fn in zip(EXECUTABLES, eng._fns):
+            wrapped.append(None if fn is None
+                           else self._wrap(name, fn))
+        eng._fns = tuple(wrapped)
+
+    def _wrap(self, name, fn):
+        import jax
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            sig = _sig_of(name, args, kwargs)
+            new = sig not in self.first_ms
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if new:
+                jax.block_until_ready(out)
+                self.first_ms[sig] = (time.perf_counter() - t0) * 1e3
+                self.by_name[name].add(sig)
+            return out
+        return wrapper
+
+
+def _drive_plain(eng) -> None:
+    """Scripted workload, plain engine: admission wave → fused K=4
+    steady decode → chunked prefill (decode K=1 alongside) →
+    quarantine replay → drain."""
+    eng.submit([1, 2, 3, 4, 5], max_new_tokens=6)
+    eng.submit([1, 2, 3, 4, 5, 6], max_new_tokens=6)
+    for _ in range(4):
+        eng.step()
+    eng.submit(list(range(1, 13)), max_new_tokens=6)
+    for _ in range(30):
+        eng.step()
+        if not eng.slot_req and not eng.queue:
+            break
+    # long enough for several fused rounds: the poison must land on a
+    # page a FUTURE dispatch reads (the in-flight block already holds
+    # the clean pool), and the quarantined request must then replay
+    eng.submit([7, 8, 9], max_new_tokens=24)
+    eng.submit([9, 8, 7, 6], max_new_tokens=24)
+    poisoned = False
+    for _ in range(60):
+        if not poisoned:
+            poisoned = eng._poison_one_slot()
+        eng.step()
+        if poisoned and not eng.slot_req and not eng.queue:
+            break
+
+
+def _drive_spec(eng) -> None:
+    """Scripted workload, speculative engine: 3 requests over 2 slots
+    keeps the queue non-empty (verify K=1), then steady state fuses
+    (verify K=4)."""
+    eng.submit([1, 2, 3, 4, 5], max_new_tokens=8)
+    eng.submit([2, 3, 4, 5, 6], max_new_tokens=8)
+    eng.submit([3, 4, 5, 6, 7], max_new_tokens=8)
+    for _ in range(60):
+        eng.step()
+        if not eng.slot_req and not eng.queue:
+            break
+
+
+def run_census_workloads():
+    """Build both engines, shim them, run the scripted workloads.
+    Returns ``({"plain": shim, "spec": shim}, coverage_problems)`` —
+    a workload that drains without hitting its phases (no quarantine,
+    no replay, work left over) silently shrinks the census, so that is
+    reported as a CEN001 coverage loss, not ignored."""
+    shims = {}
+    problems: list[str] = []
+    eng = build_audit_engine()
+    shims["plain"] = _CensusShim(eng)
+    _drive_plain(eng)
+    if eng.slots_quarantined < 1 or eng.requests_retried < 1:
+        problems.append(
+            "plain workload: the quarantine→replay phase never fired "
+            f"(quarantined={eng.slots_quarantined}, "
+            f"retried={eng.requests_retried})")
+    if eng.slot_req or eng.queue:
+        problems.append(
+            f"plain workload did not drain ({len(eng.slot_req)} slots "
+            f"busy, {len(eng.queue)} queued)")
+    eng_s = build_audit_engine(spec=True)
+    shims["spec"] = _CensusShim(eng_s)
+    _drive_spec(eng_s)
+    if eng_s.slot_req or eng_s.queue:
+        problems.append(
+            f"spec workload did not drain ({len(eng_s.slot_req)} "
+            f"slots busy, {len(eng_s.queue)} queued)")
+    return shims, problems
+
+
+def expected_signatures() -> dict[str, frozenset]:
+    """The enumerated expected lowering-signature set, per workload
+    engine.  Shapes follow from ``AUDIT_SHAPE``: B = n_slots = 2,
+    buckets (8, 16), page 8 (so one prompt page per bucket-8 wave),
+    chunk 8, and a per-slot page-table width of 10 (the engine sizes
+    max_pages past max_seq_len/page for the decode tail).  ANY drift
+    here — a new wave shape, a changed argument — is a recompile in
+    production and must be accounted for by editing this enumeration
+    in the same PR that changes the engine.
+
+    Notably ABSENT, by design of the engine the census proves out:
+    no per-length prefill signatures (bucketing), no per-k adopt
+    beyond the power-of-two wave sizes the workload admits, and the
+    quarantine replay re-admits through the SAME chunk-path
+    signatures (prefix aliasing), not a fresh bucket-16 wave."""
+    B, PT = 2, 10
+    key = "uint32[2]"
+    zb, zf = f"int32[{B}]", f"float32[{B}]"
+    pt = f"int32[{B}x{PT}]"
+    act = f"bool[{B}]"
+    s = "int32[]"
+
+    def wave(k):
+        # prefill_wave(params, padded[k,8], lens[k], temps[k], key, rid)
+        return (f"prefill_wave(int32[{k}x8],int32[{k}],"
+                f"float32[{k}],{key},{s})")
+
+    def adopt(k):
+        # adopt_wave(pool, cache_w, page_dst[k,1], slots[k], firsts[k],
+        #            lens[k], temps[k], first_toks[B], tokens[B],
+        #            pos[B], temps[B], k)   — k is the static tail arg
+        return (f"adopt_wave(int32[{k}x1],int32[{k}],int32[{k}],"
+                f"int32[{k}],float32[{k}],{zb},{zb},{zb},{zf},{k})")
+
+    decode = (f"decode_block({pt},{zb},{zb},{zb},{zb},{act},{zf},"
+              f"{key},{s})")
+    fused = (f"decode_fused({pt},{zb},{zb},{zb},{zb},{act},{zf},"
+             f"{zb},{zb},{key},{s})")
+    chunk = (f"prefill_chunk(int32[1x8],int32[1x{PT}],{s},int32[1],"
+             f"float32[1],{key},{s})")
+    activate = (f"activate_slot({zb},{zb},{zb},{zf},{s},int32[1],"
+                f"int32[1],float32[1])")
+    verify = f"verify_block({pt},{zb},{zb},{zb},{zb},{act},{zb})"
+    vfused = (f"verify_fused({pt},{zb},{zb},{zb},{zb},{act},{zb},"
+              f"{zb},{zb})")
+
+    plain = {
+        wave(2), adopt(2),   # phase 1+3: paired same-bucket admission
+        fused,               # steady-state fused K=4 decode
+        chunk, activate,     # phase 2: chunked prefill (len 12 > chunk)
+                             # — ALSO the quarantine replay's path
+        decode,              # K=1 decode while a chunk is in flight
+    }
+    spec = {
+        wave(2), adopt(2),   # paired admission
+        wave(1), adopt(1),   # third request admits solo when freed
+        verify,              # K=1 verify while the queue is non-empty
+        vfused,              # steady-state fused speculative K=4
+    }
+    return {"plain": frozenset(plain), "spec": frozenset(spec)}
+
+
+def _shape_diff(sig: str, expected: set) -> str:
+    """For an off-census signature, show the nearest expected one for
+    the same executable so the offending shape diff is obvious."""
+    name = sig.split("(", 1)[0]
+    peers = sorted(e for e in expected if e.startswith(name + "("))
+    if not peers:
+        return f"no expected signatures at all for `{name}`"
+    best = min(peers, key=lambda e: sum(
+        a != b for a, b in zip(e, sig)) + abs(len(e) - len(sig)))
+    return f"nearest expected: {best}"
+
+
+def compile_census():
+    """Run the scripted workloads and diff observed vs expected
+    signatures.  Returns ``(findings, summary)``; the summary carries
+    the ``cb_compile_census`` bench row payload (signature count +
+    first-compile ms per executable)."""
+    shims, problems = run_census_workloads()
+    expected = expected_signatures()
+    findings: list[Finding] = []
+    here = "kubegpu_tpu/analysis/jaxpr_audit.py"
+    summary: dict = {"engines": {}, "per_executable": {}}
+    for p in problems:
+        findings.append(Finding(code="CEN001", path=here, line=0,
+                                message=p))
+    for label, shim in shims.items():
+        obs = frozenset(shim.first_ms)
+        exp = expected[label]
+        for sig in sorted(obs - exp):
+            findings.append(Finding(
+                code="CEN001", path=here, line=0,
+                message=(f"[{label}] UNEXPECTED lowering signature "
+                         f"(recompilation hazard): {sig} — "
+                         f"{_shape_diff(sig, exp)}")))
+        for sig in sorted(exp - obs):
+            findings.append(Finding(
+                code="CEN001", path=here, line=0,
+                message=(f"[{label}] expected signature never "
+                         f"dispatched (census lost coverage): {sig}")))
+        summary["engines"][label] = {
+            "observed": len(obs), "expected": len(exp),
+            "total_first_compile_ms": round(
+                sum(shim.first_ms.values()), 2)}
+        for name, sigs in shim.by_name.items():
+            row = summary["per_executable"].setdefault(
+                name, {"signatures": 0, "first_compile_ms": 0.0})
+            row["signatures"] += len(sigs)
+            row["first_compile_ms"] = round(
+                row["first_compile_ms"]
+                + sum(shim.first_ms[s] for s in sigs), 2)
+    summary["signatures_total"] = sum(
+        e["observed"] for e in summary["engines"].values())
+    return findings, summary

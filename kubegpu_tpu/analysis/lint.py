@@ -1,0 +1,558 @@
+"""Prong 2 of KTP-Audit: repo-native AST lint rules with stable codes.
+
+Every rule guards an invariant the serving hot path depends on but
+that nothing type-level enforces — the exact class of regression the
+fused-tick work (PR 8) paid down and one stray edit re-introduces:
+
+=======  ============================================================
+code     rule
+=======  ============================================================
+KTP001   ``list.pop(0)`` — O(n) shift per pop; use ``collections
+         .deque`` (``popleft``) or ``heapq`` when pops must be sorted
+KTP002   implicit host sync in device-code layers (``models/``,
+         ``ops/``, ``parallel/``): ``np.asarray``/``np.array``/
+         ``.item()``/``jax.device_get``/``float|int|bool(jnp.…)`` —
+         every fetch outside the blessed gates (``_collect`` /
+         ``_consume_fused`` & co) is a hidden round trip under the
+         TPU tunnel
+KTP003   unseeded RNG (``random.*``, ``np.random.*``) or wall-clock
+         (``time.*``, ``datetime.now``) inside TRACED functions —
+         traced once, baked into the executable, silently stale ever
+         after
+KTP004   metric/span name observed anywhere in the package must
+         appear in the ``obs/metrics.py`` METRICS TABLE (the
+         documented-name registry parsed from that docstring)
+KTP005   unbounded growth: a list/dict attribute of a long-lived
+         engine/pool/tracer/registry class appended outside
+         ``__init__`` with no eviction anywhere in the class (no
+         pop/del/clear/slice/reassign and no ``deque(maxlen=…)``)
+KTP006   inconsistent locking: an attribute a lock-owning class
+         mutates under ``with self._lock`` in one method but bare in
+         another — in a ``threading``-importing module that is a data
+         race, not a style choice
+=======  ============================================================
+
+Sites are silenced via ``analysis/blessed_sites.toml`` or an inline
+``# ktp: allow(KTPxxx) reason`` pin — see :mod:`.blessed`.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from .blessed import Blessings, inline_allow
+from .report import Finding
+
+RULES = {
+    "KTP001": "list.pop(0) on a hot path (use collections.deque)",
+    "KTP002": "implicit host sync outside the blessed fetch gates",
+    "KTP003": "unseeded RNG / wall-clock read inside a traced function",
+    "KTP004": "metric/span name missing from the METRICS TABLE",
+    "KTP005": "unbounded list/dict growth in a long-lived class",
+    "KTP006": "shared mutable state written without the class lock",
+}
+
+# KTP002 applies to the device-code layers only — the host layers
+# (scheduler, kubemeta, benchmark) fetch by design.
+_HOT_PATH_DIRS = ("models", "ops", "parallel")
+
+# KTP005's notion of "long-lived": classes that survive across
+# requests/ticks and accumulate per-event state.
+_LONG_LIVED_RE = re.compile(
+    r"Batcher|Pool|Tracer|Trace|Registry|Scheduler|Engine|Injector")
+
+# KTP004 source scan (regex, matching observe/inc/set_gauge and span
+# recording calls — \s* after the paren because several call sites
+# wrap the name onto the next line)
+METRIC_CALL_RE = re.compile(
+    r"\.(?:inc|observe|set_gauge)\(\s*[\"']([a-z0-9_]+)[\"']", re.S)
+SPAN_CALL_RE = re.compile(
+    r"\.(?:start_span|span|add_span|instant)\(\s*[\"']"
+    r"([a-z0-9_]+\.[a-z0-9_.]+|request)[\"']", re.S)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted source of a call target ('np.asarray',
+    'time.perf_counter', ...); '' when it isn't a plain name chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _self_attr(n: ast.AST) -> str | None:
+    if (isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name)
+            and n.value.id == "self"):
+        return n.attr
+    return None
+
+
+_GROW_METHODS = {"append", "extend", "add", "appendleft", "insert",
+                 "setdefault", "update"}
+_EVICT_METHODS = {"pop", "popleft", "popitem", "clear", "remove"}
+
+
+def _flat_targets(t: ast.AST):
+    """Flatten tuple/list/starred assignment targets —
+    ``(a, self.pool, b) = fn()`` reassigns ``self.pool`` too."""
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _flat_targets(e)
+    elif isinstance(t, ast.Starred):
+        yield from _flat_targets(t.value)
+    else:
+        yield t
+
+
+def _container_kind(v: ast.AST | None) -> str:
+    if isinstance(v, ast.List) or (
+            isinstance(v, ast.Call) and _dotted(v.func) == "list"):
+        return "list"
+    if isinstance(v, ast.Dict) or (
+            isinstance(v, ast.Call) and _dotted(v.func) == "dict"):
+        return "dict"
+    return ""
+
+
+def _attr_effects(node: ast.AST):
+    """Yield ``(attr, effect, detail)`` for one AST node's effect on a
+    ``self.X`` attribute: effect is ``assign`` (detail = 'list' /
+    'dict' / '' for the initialized container kind), ``grow``, or
+    ``evict``."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t0 in targets:
+            for t in _flat_targets(t0):
+                a = _self_attr(t)
+                if a is not None:
+                    yield a, "assign", _container_kind(node.value)
+                elif isinstance(t, ast.Subscript):
+                    a = _self_attr(t.value)
+                    if a is not None:
+                        yield a, "grow", ""   # self.x[k] = v
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            a = _self_attr(f.value)
+            if a is not None:
+                if f.attr in _GROW_METHODS:
+                    yield a, "grow", ""
+                elif f.attr in _EVICT_METHODS:
+                    yield a, "evict", ""
+        # a self attribute handed to a trim/prune/evict/drain helper
+        # (e.g. serve.py's _trim_acct sweep) is being bounded by it
+        if re.search(r"trim|prune|evict|drain", _dotted(f) or ""):
+            for arg in node.args:
+                a = _self_attr(arg)
+                if a is not None:
+                    yield a, "evict", ""
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            a = (_self_attr(t.value)
+                 if isinstance(t, ast.Subscript) else _self_attr(t))
+            if a is not None:
+                yield a, "evict", ""
+
+
+class _Qualnames(ast.NodeVisitor):
+    """line → enclosing function qualname ('' at module level)."""
+
+    def __init__(self, tree: ast.Module):
+        self.stack: list[str] = []
+        self.by_node: dict[ast.AST, str] = {}
+        self.visit(tree)
+
+    def _enter(self, node):
+        self.stack.append(node.name)
+        self.by_node[node] = ".".join(self.stack)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _enter
+
+    def qual_of(self, node: ast.AST, tree: ast.Module) -> str:
+        """Qualname of the innermost def/class containing ``node``
+        (by position)."""
+        best = ""
+        for fn, q in self.by_node.items():
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if (fn.lineno <= node.lineno
+                    <= max(fn.end_lineno or fn.lineno, fn.lineno)):
+                if not best or len(q) > len(best):
+                    best = q
+        return best
+
+
+class FileLinter:
+    """Run every AST rule over one source file."""
+
+    def __init__(self, path: pathlib.Path, root: pathlib.Path,
+                 blessings: Blessings):
+        self.path = path
+        self.rel = str(path.relative_to(root.parent)
+                       if root.parent in path.parents or root == path
+                       else path)
+        self.blessings = blessings
+        self.src = path.read_text()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src)
+        self.quals = _Qualnames(self.tree)
+        self.imports_jax = bool(re.search(
+            r"^\s*(import jax|from jax)", self.src, re.M))
+        self.imports_threading = bool(re.search(
+            r"^\s*import threading|^\s*from threading", self.src, re.M))
+        self.findings: list[Finding] = []
+
+    # -- plumbing -------------------------------------------------------
+
+    def _emit(self, rule: str, node_or_line, message: str) -> None:
+        line = (node_or_line if isinstance(node_or_line, int)
+                else node_or_line.lineno)
+        qual = "" if isinstance(node_or_line, int) else \
+            self.quals.qual_of(node_or_line, self.tree)
+        reason = inline_allow(self.lines, line, rule) \
+            or self.blessings.lint_reason(rule, self.rel, qual)
+        self.findings.append(Finding(
+            code=rule, path=self.rel, line=line, message=message,
+            blessed=reason is not None, reason=reason or ""))
+
+    def run(self) -> list[Finding]:
+        self._ktp001()
+        if self.imports_jax and any(
+                f"/{d}/" in self.path.as_posix()
+                for d in _HOT_PATH_DIRS):
+            self._ktp002()
+        if self.imports_jax:
+            self._ktp003()
+        self._ktp005()
+        if self.imports_threading:
+            self._ktp006()
+        return self.findings
+
+    # -- KTP001: list.pop(0) -------------------------------------------
+
+    def _ktp001(self) -> None:
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "pop"
+                    and len(node.args) == 1 and not node.keywords):
+                continue
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and a.value == 0:
+                self._emit("KTP001", node,
+                           "pop(0) shifts the whole list per pop — "
+                           "use collections.deque.popleft() (or heapq "
+                           "when pops must come out sorted)")
+
+    # -- KTP002: implicit host sync ------------------------------------
+
+    _SYNC_FUNCS = {"np.asarray", "np.array", "numpy.asarray",
+                   "numpy.array", "jax.device_get", "onp.asarray"}
+
+    def _ktp002(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted in self._SYNC_FUNCS:
+                self._emit("KTP002", node,
+                           f"{dotted}() forces a device→host fetch; "
+                           "route it through a blessed fetch gate or "
+                           "bless this site with a reason")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                self._emit("KTP002", node,
+                           ".item() is a per-element host sync — "
+                           "batch it into the tick's single fused "
+                           "fetch")
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Call)):
+                inner = _dotted(node.args[0].func)
+                if inner.startswith(("jnp.", "jax.numpy.", "jax.lax.",
+                                     "lax.")):
+                    self._emit(
+                        "KTP002", node,
+                        f"{node.func.id}({inner}(…)) blocks on the "
+                        "device — keep the value on device or fetch "
+                        "it through a blessed gate")
+
+    # -- KTP003: RNG / wall-clock inside traced functions --------------
+
+    _IMPURE_RE = re.compile(
+        r"^(time\.(time|perf_counter|monotonic|process_time)"
+        r"|datetime\.(datetime\.)?now"
+        r"|random\.[a-z]\w*"
+        r"|np\.random\.\w+|numpy\.random\.\w+)$")
+
+    _JIT_LIKE_RE = re.compile(
+        r"\b(jit|shard_map|sharded_jit|pallas_call|make_jaxpr|"
+        r"checkpoint|remat|vmap|pmap|scan|while_loop|cond)\b")
+
+    def _traced_defs(self) -> list[ast.FunctionDef]:
+        """Functions that end up inside a trace: defs decorated with
+        jit/shard_map/pallas_call variants, or passed by bare name to
+        such a call FROM THE SAME LEXICAL SCOPE — a method that merely
+        shares its name with some scan body elsewhere in the file must
+        not be tarred by it (``ContinuousBatcher.step`` is host code;
+        the ``def step(carry, xs)`` scan bodies are not)."""
+        jit_like = self._JIT_LIKE_RE
+        refs: list[tuple[str, int]] = []   # (bare name, call lineno)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                try:
+                    target = ast.unparse(node.func)
+                except Exception:
+                    continue
+                if not jit_like.search(target):
+                    continue
+                for a in list(node.args) + [k.value
+                                            for k in node.keywords]:
+                    if isinstance(a, ast.Name):
+                        refs.append((a.id, node.lineno))
+        scopes = [n for n in ast.walk(self.tree)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef,
+                                    ast.ClassDef))]
+
+        def scope_span(d: ast.AST) -> tuple[int, int]:
+            # innermost enclosing def/class; whole file at top level
+            best = None
+            for s in scopes:
+                if s is d:
+                    continue
+                if s.lineno <= d.lineno <= (s.end_lineno or s.lineno):
+                    if best is None or s.lineno > best.lineno:
+                        best = s
+            if best is None:
+                return 1, len(self.lines) or 1
+            return best.lineno, best.end_lineno or best.lineno
+
+        roots: list[ast.FunctionDef] = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            decorated = False
+            for dec in node.decorator_list:
+                try:
+                    if jit_like.search(ast.unparse(dec)):
+                        decorated = True
+                except Exception:
+                    pass
+            if decorated:
+                roots.append(node)
+                continue
+            lo, hi = scope_span(node)
+            if any(name == node.name and lo <= ln <= hi
+                   for name, ln in refs):
+                roots.append(node)
+        return roots
+
+    def _ktp003(self) -> None:
+        seen: set[int] = set()
+        for root in self._traced_defs():
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func)
+                if (dotted and self._IMPURE_RE.match(dotted)
+                        and node.lineno not in seen):
+                    seen.add(node.lineno)
+                    self._emit(
+                        "KTP003", node,
+                        f"{dotted}() inside traced function "
+                        f"'{root.name}' — traced once at compile, "
+                        "the value is frozen into the executable; "
+                        "thread seeds/timestamps in as arguments")
+
+    # -- KTP005: unbounded growth in long-lived classes ----------------
+
+    def _ktp005(self) -> None:
+        for cls in ast.walk(self.tree):
+            if not (isinstance(cls, ast.ClassDef)
+                    and _LONG_LIVED_RE.search(cls.name)):
+                continue
+            grown: dict[str, ast.AST] = {}     # attr → first grow site
+            init_kind: dict[str, str] = {}     # attr → list | dict
+            evicted: set[str] = set()
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                is_init = meth.name == "__init__"
+                for node in ast.walk(meth):
+                    for attr, effect, detail in _attr_effects(node):
+                        if effect == "assign":
+                            if is_init:
+                                init_kind.setdefault(attr, detail)
+                            else:
+                                evicted.add(attr)   # reassign = reset
+                        elif effect == "grow" and not is_init:
+                            grown.setdefault(attr, node)
+                        elif effect == "evict":
+                            evicted.add(attr)
+            for attr, site in sorted(grown.items()):
+                if attr in evicted:
+                    continue
+                if init_kind.get(attr) not in ("list", "dict"):
+                    continue
+                self._emit(
+                    "KTP005", site,
+                    f"'{cls.name}.{attr}' grows per event with no "
+                    "eviction anywhere in the class — bound it "
+                    "(deque(maxlen=…), an eviction sweep) or bless "
+                    "it with the lifetime argument")
+
+    # -- KTP006: inconsistent locking ----------------------------------
+
+    def _ktp006(self) -> None:
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = self._lock_attrs(cls)
+            if not locks:
+                continue
+            locked_writes: set[str] = set()
+            bare_writes: dict[str, list[ast.AST]] = {}
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name == "__init__":
+                    continue
+                if meth.name.endswith("_locked"):
+                    # repo convention: a ``*_locked`` method's contract
+                    # is "caller holds the lock" — its writes are
+                    # locked writes, just not lexically under a With
+                    for node in ast.walk(meth):
+                        attr = self._written_attr(node)
+                        if attr is not None and attr not in locks:
+                            locked_writes.add(attr)
+                    continue
+                locked_spans = self._lock_spans(meth, locks)
+                for node in ast.walk(meth):
+                    attr = self._written_attr(node)
+                    if attr is None or attr in locks:
+                        continue
+                    if any(s <= node.lineno <= e
+                           for s, e in locked_spans):
+                        locked_writes.add(attr)
+                    else:
+                        bare_writes.setdefault(attr, []).append(node)
+            for attr in sorted(locked_writes & set(bare_writes)):
+                node = bare_writes[attr][0]
+                self._emit(
+                    "KTP006", node,
+                    f"'{cls.name}.{attr}' is written under the class "
+                    "lock elsewhere but bare here — in a threading-"
+                    "importing module that is a data race; take the "
+                    "lock or bless with the single-writer argument")
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(cls):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"
+                    and isinstance(node.value, ast.Call)
+                    and _dotted(node.value.func) in (
+                        "threading.Lock", "threading.RLock",
+                        "threading.Condition", "Lock", "RLock")):
+                out.add(node.targets[0].attr)
+        return out
+
+    def _lock_spans(self, meth: ast.AST,
+                    locks: set[str]) -> list[tuple[int, int]]:
+        spans = []
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) and e.attr in locks:
+                    spans.append((node.lineno,
+                                  node.end_lineno or node.lineno))
+        return spans
+
+    def _written_attr(self, node: ast.AST) -> str | None:
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets
+                       if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                a = _self_attr(t)
+                if a is not None:
+                    return a
+                if isinstance(t, ast.Subscript):
+                    a = _self_attr(t.value)
+                    if a is not None:
+                        return a
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in (_GROW_METHODS
+                                       | _EVICT_METHODS)):
+            return _self_attr(node.func.value)
+        return None
+
+
+# -- KTP004: metric/span census against the documented registry --------
+
+def lint_metric_names(root: pathlib.Path,
+                      blessings: Blessings) -> list[Finding]:
+    """Every metric name observed (``inc``/``observe``/``set_gauge``)
+    and every span name recorded anywhere under ``root`` must appear
+    in the obs/metrics.py documented-name registry (the METRICS TABLE
+    parsed by :func:`kubegpu_tpu.obs.metrics.documented_names`)."""
+    from kubegpu_tpu.obs.metrics import documented_names
+    docs = documented_names()
+    findings: list[Finding] = []
+    for path in sorted(root.rglob("*.py")):
+        src = path.read_text()
+        rel = str(path.relative_to(root.parent))
+        lines = src.splitlines()
+        for regex, kind, documented in (
+                (METRIC_CALL_RE, "metric", docs["metrics"]),
+                (SPAN_CALL_RE, "span", docs["spans"])):
+            for m in regex.finditer(src):
+                name = m.group(1)
+                if name in documented:
+                    continue
+                line = src.count("\n", 0, m.start()) + 1
+                reason = inline_allow(lines, line, "KTP004")
+                findings.append(Finding(
+                    code="KTP004", path=rel, line=line,
+                    message=(f"{kind} name '{name}' is observed here "
+                             "but missing from the METRICS TABLE in "
+                             "obs/metrics.py — add a table row"),
+                    blessed=reason is not None, reason=reason or ""))
+    return findings
+
+
+def lint_package(root: pathlib.Path,
+                 blessings: Blessings | None = None,
+                 with_metrics_census: bool = True) -> list[Finding]:
+    """Run every AST rule over all ``*.py`` under ``root`` (the
+    ``kubegpu_tpu`` package dir) + the KTP004 name census."""
+    blessings = blessings or Blessings.load()
+    findings: list[Finding] = []
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        findings.extend(FileLinter(path, root, blessings).run())
+    if with_metrics_census:
+        findings.extend(lint_metric_names(root, blessings))
+    return findings

@@ -1,0 +1,100 @@
+"""Shared finding model + report rendering for the KTP-Audit passes.
+
+Both prongs (the AST lint engine and the jaxpr/HLO auditor) reduce to
+a flat list of :class:`Finding`; the CLI renders them as a human
+report (one ``CODE path:line message`` row per finding, grouped by
+rule) or a JSON document, and exits nonzero iff any finding survived
+the blessed-site allowlist.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class Finding:
+    """One rule violation.
+
+    ``code``    stable rule id (``KTP001``.. for lints, ``JXA00x`` for
+                jaxpr-audit findings, ``CEN001`` for the compile census)
+    ``path``    repo-relative file (lints) or ``<executable>`` (audit)
+    ``line``    1-indexed line, 0 when the finding has no source anchor
+    ``message`` human sentence; carries the offending shape diff for
+                census findings
+    ``blessed`` True when an allowlist entry (TOML or inline comment)
+                covers the site — blessed findings are reported in the
+                JSON document but never fail the run
+    """
+
+    code: str
+    path: str
+    line: int
+    message: str
+    blessed: bool = False
+    reason: str = ""   # blessing reason, when blessed
+
+    def key(self) -> tuple:
+        return (self.code, self.path, self.line)
+
+
+@dataclass
+class Report:
+    """Aggregated result of one analysis run."""
+
+    findings: list[Finding] = field(default_factory=list)
+    # pass name → summary payload (census signature sets, executable
+    # walk stats, ...) carried into the JSON document
+    summaries: dict = field(default_factory=dict)
+
+    def extend(self, fs) -> None:
+        self.findings.extend(fs)
+
+    @property
+    def violations(self) -> list[Finding]:
+        return [f for f in self.findings if not f.blessed]
+
+    @property
+    def blessed(self) -> list[Finding]:
+        return [f for f in self.findings if f.blessed]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_doc(self) -> dict:
+        return {
+            "ok": self.ok,
+            "violations": [asdict(f) for f in self.violations],
+            "blessed": [asdict(f) for f in self.blessed],
+            "summaries": self.summaries,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_doc(), indent=2, sort_keys=True)
+
+    def render(self) -> str:
+        """Human report: violations grouped by rule code, blessed
+        sites as a one-line tally."""
+        lines: list[str] = []
+        by_code: dict[str, list[Finding]] = {}
+        for f in self.violations:
+            by_code.setdefault(f.code, []).append(f)
+        for code in sorted(by_code):
+            for f in sorted(by_code[code], key=lambda f: f.key()):
+                loc = f"{f.path}:{f.line}" if f.line else f.path
+                lines.append(f"{code} {loc}  {f.message}")
+        if self.blessed:
+            lines.append(
+                f"[blessed] {len(self.blessed)} allowlisted site(s) "
+                "suppressed (see --json for the list)")
+        for name, summary in sorted(self.summaries.items()):
+            brief = summary.get("brief") if isinstance(summary, dict) \
+                else None
+            if brief:
+                lines.append(f"[{name}] {brief}")
+        lines.append("ANALYSIS " + ("CLEAN" if self.ok else
+                                    f"FAILED ({len(self.violations)} "
+                                    "violation(s))"))
+        return "\n".join(lines)

@@ -2031,6 +2031,27 @@ def run_serving_bench_smoke() -> dict:
         "cb_fused_ticks": _cb_fused_bench(
             params, cfg, slots=3, prompt=16, new=24, stride=2, page=8,
             reqs=3, ks=(1, 4)),
+        "cb_compile_census": _cb_compile_census_bench(),
+    }
+
+
+def _cb_compile_census_bench() -> dict:
+    """The KTP-Audit compile-signature census as a bench row: how many
+    distinct lowering signatures the scripted serving workload
+    (admission wave → chunked prefill → spec ticks → fused K∈{1,4} →
+    quarantine replay) compiles, and the first-compile wall per
+    executable.  ``violations`` MUST be 0 — a nonzero count means a
+    dispatch shape drifted off the enumerated expected set in
+    kubegpu_tpu/analysis/jaxpr_audit.py (a recompilation hazard in
+    production)."""
+    from kubegpu_tpu.analysis.jaxpr_audit import compile_census
+    findings, summary = compile_census()
+    return {
+        "violations": len(findings),
+        "violation_messages": [f.message for f in findings],
+        "signatures_total": summary["signatures_total"],
+        "per_executable": summary["per_executable"],
+        "engines": summary["engines"],
     }
 
 

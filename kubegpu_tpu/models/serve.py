@@ -1266,6 +1266,20 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
 # The engine
 # ---------------------------------------------------------------------------
 
+_ACCT_CAP = 32768   # per-tick accounting window (entries per list)
+
+
+def _trim_acct(xs: list) -> None:
+    """Eviction sweep for the host-side per-tick accounting lists
+    (``stall_ms``, ``wave_sizes``, ``_tick_log``, …): once a list
+    exceeds ``_ACCT_CAP`` drop the oldest half, so an engine serving
+    indefinitely holds a bounded recent window — the summaries the
+    benches read are over recent ticks either way.  Amortized O(1);
+    smoke runs never reach the cap, so their numbers are unchanged."""
+    if len(xs) > _ACCT_CAP:
+        del xs[:len(xs) - _ACCT_CAP // 2]
+
+
 @dataclass
 class _Request:
     rid: int
@@ -2133,8 +2147,8 @@ class ContinuousBatcher:
     def _admit(self) -> None:
         from kubegpu_tpu.ops.paged_attention import decode_capacity
         prefill_wave, adopt_wave = self._fns[1], self._fns[2]
-        free = [s for s in range(self.n_slots)
-                if s not in self.slot_req]
+        free = deque(s for s in range(self.n_slots)
+                     if s not in self.slot_req)
         while free and self.queue:
             req0, p0 = self.queue[0]
             if req0.not_before_tick > self._step_count:
@@ -2166,7 +2180,7 @@ class ContinuousBatcher:
                 # admit per-slot through the chunk path — no wave
                 if hits0 or (self.chunked_prefill
                              and p0.shape[1] > self.prefill_chunk):
-                    self._admit_chunked(free.pop(0), hits0)
+                    self._admit_chunked(free.popleft(), hits0)
                     continue
             # WAVE admission: consecutive queue-front requests sharing
             # one prompt bucket prefill as a single [k, bucket] batch
@@ -2208,7 +2222,7 @@ class ContinuousBatcher:
                         ) > self._available_pages():
                     k //= 2
             wave = [self.queue.popleft() for _ in range(k)]
-            slots = [free.pop(0) for _ in range(k)]
+            slots = [free.popleft() for _ in range(k)]
             padded = jnp.concatenate([p for _, p in wave], axis=0)
             true_lens = jnp.asarray(
                 [r.admit_len for r, _ in wave], jnp.int32)
@@ -2877,6 +2891,13 @@ class ContinuousBatcher:
             self.check_page_invariants()
         self._note_host_overhead(t_tick, self._sync_ms_last)
         self._watchdog(t_tick, finished)
+        _trim_acct(self.stall_ms)
+        _trim_acct(self.wave_sizes)
+        _trim_acct(self.wave_log)
+        _trim_acct(self.overlap_ms)
+        _trim_acct(self.fused_block_ms)
+        _trim_acct(self.host_overhead_ms)
+        _trim_acct(self._tick_log)
         return finished
 
     def _note_host_overhead(self, t_tick: float,
@@ -3399,7 +3420,7 @@ class DataParallelServePool:
         # evictions observed (from a watch or an explicit call) that
         # the next step() turns into failovers
         self._gang_replica: dict[str, int] = {}
-        self._pending_deaths: list[tuple[int, str]] = []
+        self._pending_deaths: deque[tuple[int, str]] = deque()
         self._unsub = None
 
     def warmup(self) -> None:
@@ -3447,7 +3468,7 @@ class DataParallelServePool:
         """A serving gang died in the control plane (the health
         controller evicted it).  The bound replica is marked for death;
         the next step() fails its requests over to healthy replicas."""
-        i = self._gang_replica.get(gang)
+        i = self._gang_replica.pop(gang, None)   # gang is gone: unlink
         if i is not None and i not in self.dead_replicas:
             self._pending_deaths.append((i, f"{reason} (gang {gang})"))
 
@@ -3565,6 +3586,7 @@ class DataParallelServePool:
         dt = (time.perf_counter() - t0) * 1e3
         if n_replayed or resident:
             self.replay_ms.append(dt)
+            _trim_acct(self.replay_ms)
             if self._metrics is not None:
                 self._metrics.observe("serve_replay_ms", dt)
         if fo_span is not None:
@@ -3607,7 +3629,7 @@ class DataParallelServePool:
     def step(self) -> list[_Request]:
         done: list[_Request] = []
         while self._pending_deaths:
-            i, reason = self._pending_deaths.pop(0)
+            i, reason = self._pending_deaths.popleft()
             if i in self.dead_replicas:
                 continue
             self.replicas[i].dead = reason   # engine refuses new work

@@ -355,6 +355,28 @@ def percentiles(values, ps=(50, 90, 99)) -> dict:
     return out
 
 
+def documented_names() -> dict[str, frozenset]:
+    """The documented-name REGISTRY: every metric and span name the
+    METRICS TABLE above declares, parsed from this module's docstring
+    (the table is the single source of truth — KTP004 in
+    ``kubegpu_tpu/analysis/lint.py`` and the tier-1 census in
+    ``tests/test_obs_spans.py`` both consume this instead of keeping
+    their own hand-maintained copies).
+
+    A *metric* row is any ````name```` literal of plain snake_case; a
+    *span* name additionally contains a dot (``engine.tick``) or is
+    the bare ``request`` root.  Returns
+    ``{"metrics": frozenset, "spans": frozenset}``; span names are
+    also valid ``add_span`` targets so both sets include the dotted
+    names."""
+    import re
+    doc = __doc__ or ""
+    names = set(re.findall(r"``([a-z0-9_][a-z0-9_.]*)``", doc))
+    spans = frozenset(n for n in names if "." in n) | {"request"}
+    metrics = frozenset(n for n in names if "." not in n)
+    return {"metrics": metrics, "spans": frozenset(spans)}
+
+
 global_registry = MetricsRegistry()
 
 

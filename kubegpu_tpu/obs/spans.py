@@ -49,6 +49,7 @@ TRACE_ANNOTATION = "pod.alpha.kubetpu/trace-context"
 TRACE_ENV = "KUBETPU_TRACE_CONTEXT"
 
 _SPAN_CAPACITY = 65536
+_GANG_LINK_CAP = 4096   # gang → trace-root links kept (FIFO evicted)
 
 
 class SpanContext:
@@ -221,6 +222,10 @@ class Tracer:
             ctx = ctx.context
         with self._lock:
             self._gangs[gang] = ctx
+            # bounded like the span deques: gangs churn forever in a
+            # long-lived daemon; drop the oldest links past capacity
+            while len(self._gangs) > _GANG_LINK_CAP:
+                self._gangs.pop(next(iter(self._gangs)))
 
     def gang_context(self, gang: str) -> SpanContext | None:
         with self._lock:

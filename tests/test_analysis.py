@@ -1,0 +1,365 @@
+"""KTP-Audit (ISSUE 9): the static-analysis subsystem must CATCH the
+bad patterns it exists for (negative fixtures per rule, a deliberately
+bad executable for the jaxpr auditor), HONOR the two blessing channels
+(TOML entries, inline pins), and hold the repo itself clean — the
+tier-1 gate that makes every rule a standing invariant rather than a
+one-shot cleanup.
+
+The compile-signature census drives real engine workloads through
+real compiles, so it is ``slow``-marked here; tier-1 still runs it via
+the ``cb_compile_census`` bench row (tests/test_bench_smoke.py).
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kubegpu_tpu.analysis.blessed import Blessings, inline_allow
+from kubegpu_tpu.analysis.lint import (
+    RULES,
+    FileLinter,
+    lint_metric_names,
+    lint_package,
+)
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent / "kubegpu_tpu"
+EMPTY = Blessings({})
+
+
+def _lint(tmp_path, src, *, subdir="models", name="bad.py",
+          blessings=EMPTY):
+    """Write a snippet under a fake package root and lint it.  The
+    subdir matters: KTP002 only arms inside the device-code layers."""
+    d = tmp_path / "fakepkg" / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(src))
+    return FileLinter(p, tmp_path / "fakepkg", blessings).run()
+
+
+def _codes(findings, blessed=False):
+    return sorted({f.code for f in findings if f.blessed == blessed})
+
+
+# ---------------------------------------------------------------------------
+# negative fixtures: each rule must fire on its known-bad snippet
+# ---------------------------------------------------------------------------
+
+def test_ktp001_pop_zero(tmp_path):
+    fs = _lint(tmp_path, """\
+        def drain(q):
+            while q:
+                item = q.pop(0)
+            q.pop()          # pop from the END is fine
+            return item
+        """)
+    assert _codes(fs) == ["KTP001"]
+    assert len(fs) == 1 and fs[0].line == 3
+    assert "deque" in fs[0].message
+
+
+def test_ktp002_host_sync_variants(tmp_path):
+    src = """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def leak(x):
+            a = np.asarray(x)            # fetch 1
+            b = x.item()                 # fetch 2
+            c = float(jnp.sum(x))        # fetch 3
+            return a, b, c
+        """
+    fs = _lint(tmp_path, src, subdir="models")
+    assert _codes(fs) == ["KTP002"] and len(fs) == 3
+    # the same code in a host layer is by-design and must NOT fire
+    assert _lint(tmp_path, src, subdir="scheduler") == []
+
+
+def test_ktp003_wall_clock_in_traced_fn(tmp_path):
+    fs = _lint(tmp_path, """\
+        import time
+        import jax
+
+        @jax.jit
+        def tick(x):
+            t0 = time.perf_counter()     # frozen into the executable
+            return x + t0
+        """)
+    assert _codes(fs) == ["KTP003"]
+    assert "tick" in fs[0].message
+
+
+def test_ktp003_scope_aware_name_matching(tmp_path):
+    # `Engine.step` is host code; the scan body that happens to share
+    # the name `step` is the traced one.  Only the body's RNG fires.
+    fs = _lint(tmp_path, """\
+        import random
+        import jax
+        from jax import lax
+
+        class Engine:
+            def step(self):
+                return random.random()   # host code: allowed
+
+        def run(xs):
+            def step(carry, x):
+                return carry + random.random(), x
+            return lax.scan(step, 0.0, xs)
+        """)
+    assert _codes(fs) == ["KTP003"] and len(fs) == 1
+    assert fs[0].line == 11
+
+
+def test_ktp004_undocumented_metric_name(tmp_path):
+    root = tmp_path / "fakepkg"
+    root.mkdir()
+    (root / "mod.py").write_text(textwrap.dedent("""\
+        def report(metrics):
+            metrics.inc("serve_decode_stall_ms")   # in the TABLE
+            metrics.inc("totally_novel_counter")   # not in the TABLE
+        """))
+    fs = [f for f in lint_metric_names(root, EMPTY) if not f.blessed]
+    assert len(fs) == 1 and fs[0].code == "KTP004"
+    assert "totally_novel_counter" in fs[0].message
+
+
+def test_ktp005_unbounded_growth(tmp_path):
+    fs = _lint(tmp_path, """\
+        class RequestBatcher:
+            def __init__(self):
+                self.log: list = []
+                self.ring = []
+                self.pruned = []
+
+            def tick(self, ev):
+                self.log.append(ev)          # grows forever
+                self.ring.append(ev)
+                if len(self.ring) > 8:
+                    self.ring.clear()        # evicted: fine
+                self.pruned.append(ev)
+                _prune_window(self.pruned)   # eviction helper: fine
+        """)
+    assert _codes(fs) == ["KTP005"] and len(fs) == 1
+    assert ".log" in fs[0].message
+
+
+def test_ktp006_inconsistent_locking(tmp_path):
+    fs = _lint(tmp_path, """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def safe_inc(self):
+                with self._lock:
+                    self.n += 1
+
+            def racy_inc(self):
+                self.n += 1              # bare write, same attr
+        """)
+    assert _codes(fs) == ["KTP006"] and len(fs) == 1
+    assert ".n" in fs[0].message
+
+
+def test_ktp006_locked_suffix_convention(tmp_path):
+    # a ``*_locked`` method's contract is caller-holds-lock; its
+    # writes must not be reported as racy
+    fs = _lint(tmp_path, """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def inc(self):
+                with self._lock:
+                    self._inc_locked()
+
+            def _inc_locked(self):
+                self.n += 1
+        """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# blessing channels: TOML entries and inline pins
+# ---------------------------------------------------------------------------
+
+def test_toml_blessing_suppresses_with_reason(tmp_path):
+    b = Blessings({"bless": [{
+        "rule": "KTP001", "file": "models/bad.py", "func": "drain",
+        "reason": "startup-only queue, N < 10"}]})
+    fs = _lint(tmp_path, """\
+        def drain(q):
+            return q.pop(0)
+        """, blessings=b)
+    assert len(fs) == 1 and fs[0].blessed
+    assert fs[0].reason == "startup-only queue, N < 10"
+    # blessed findings still surface in the report's blessed bucket —
+    # the allowlist stays reviewable, it does not hide code
+
+
+def test_inline_pin_is_rule_specific(tmp_path):
+    # a pin covers its own line or the line below it; a pin naming a
+    # DIFFERENT rule covers nothing
+    fs = _lint(tmp_path, """\
+        def drain(q):
+            a = q.pop(0)   # ktp: allow(KTP001) bench setup, N=3
+            c = len(q)
+            b = q.pop(0)   # ktp: allow(KTP005) wrong rule pinned
+            return a, b, c
+        """)
+    by_line = {f.line: f for f in fs}
+    assert by_line[2].blessed and "N=3" in by_line[2].reason
+    assert not by_line[4].blessed
+
+
+def test_inline_allow_helper():
+    lines = ["x = 1", "y.pop(0)  # ktp: allow(KTP001) reason here"]
+    assert inline_allow(lines, 2, "KTP001") == "reason here"
+    assert inline_allow(lines, 2, "KTP002") is None
+
+
+# ---------------------------------------------------------------------------
+# jaxpr auditor: the deliberately-bad executable
+# ---------------------------------------------------------------------------
+
+def _bad_executable():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def host_sum(a):
+        return np.asarray(a, dtype=np.float32).sum(keepdims=True)
+
+    def bad(x):                          # x is bf16
+        y = jax.pure_callback(
+            host_sum, jax.ShapeDtypeStruct((1,), jnp.float32), x)
+        return x.astype(jnp.float32) + y   # silent bf16→f32 upcast
+
+    return bad
+
+
+def test_jaxpr_audit_catches_callback_and_upcast():
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.analysis.jaxpr_audit import audit_jaxpr
+    findings, stats = audit_jaxpr(
+        _bad_executable(), (jnp.zeros((4,), jnp.bfloat16),),
+        "bad_fixture", EMPTY)
+    assert _codes(findings) == ["JXA001", "JXA002"]
+    assert stats["callbacks"] >= 1 and stats["upcasts"] >= 1
+    jxa2 = next(f for f in findings if f.code == "JXA002")
+    assert "bfloat16" in jxa2.message and "bad_fixture" in jxa2.message
+
+
+def test_jaxpr_audit_honors_upcast_allowlist():
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.analysis.jaxpr_audit import audit_jaxpr
+    b = Blessings({"jaxpr": {
+        "upcast": [{"func": "bad", "reason": "fixture accumulator"}],
+        "callback": [{"func": "bad", "reason": "fixture host hook"}]}})
+    findings, _ = audit_jaxpr(
+        _bad_executable(), (jnp.zeros((4,), jnp.bfloat16),),
+        "bad_fixture", b)
+    assert _codes(findings, blessed=False) == []
+    assert _codes(findings, blessed=True) == ["JXA001", "JXA002"]
+
+
+def test_jaxpr_audit_clean_fn_is_clean():
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.analysis.jaxpr_audit import audit_jaxpr
+
+    def clean(x):
+        return (x * 2).sum()
+
+    # f32 input: jnp.sum over bf16 would (correctly) flag the f32
+    # accumulator upcast the allowlist exists for
+    findings, stats = audit_jaxpr(
+        clean, (jnp.zeros((4,), jnp.float32),), "clean", EMPTY)
+    assert findings == [] and stats["eqns"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# the repo itself must pass — the standing tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_repo_clean_lints():
+    bad = [f for f in lint_package(PKG_ROOT, Blessings.load())
+           if not f.blessed]
+    assert not bad, "\n".join(
+        f"{f.path}:{f.line} {f.code} {f.message}" for f in bad)
+
+
+def test_repo_clean_jaxpr_audit():
+    from kubegpu_tpu.analysis.jaxpr_audit import audit_engine_executables
+    findings, summary = audit_engine_executables(Blessings.load())
+    bad = [f for f in findings if not f.blessed]
+    assert not bad, "\n".join(
+        f"{f.path}:{f.line} {f.code} {f.message}" for f in bad)
+    # every serving executable was actually traced, on both engines
+    assert summary["total_eqns"] > 1000
+    labels = {k.split(":", 1)[0] for k in summary["executables"]}
+    assert labels == {"bf16", "int8"}
+    assert all(s["eqns"] > 0 for s in summary["executables"].values())
+
+
+def test_cli_flags_nonzero_on_bad_fixture(tmp_path):
+    root = tmp_path / "fixture"
+    root.mkdir()
+    (root / "hot.py").write_text("def f(q):\n    return q.pop(0)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.analysis",
+         "--lint-only", "--root", str(root)],
+        capture_output=True, text=True,
+        cwd=PKG_ROOT.parent, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "KTP001" in proc.stdout
+    assert "hot.py:2" in proc.stdout          # rule code + file:line
+
+
+def test_rule_table_is_mirrored_in_docs():
+    import kubegpu_tpu.analysis as an
+    for code, summary in RULES.items():
+        assert code in (an.__doc__ or ""), code
+
+
+# ---------------------------------------------------------------------------
+# compile-signature census (compiles for real → slow; tier-1 coverage
+# comes from the cb_compile_census bench row)
+# ---------------------------------------------------------------------------
+
+def test_expected_signature_sets_are_wellformed():
+    from kubegpu_tpu.analysis.jaxpr_audit import expected_signatures
+    exp = expected_signatures()
+    assert set(exp) == {"plain", "spec"}
+    assert len(exp["plain"]) == 6 and len(exp["spec"]) == 6
+    for sig in exp["plain"] | exp["spec"]:
+        name = sig.split("(", 1)[0]
+        assert name in {"decode_block", "decode_fused", "prefill_wave",
+                        "prefill_chunk", "adopt_wave", "activate_slot",
+                        "verify_block", "verify_fused"}, sig
+
+
+@pytest.mark.slow
+def test_compile_census_matches_expected_set():
+    from kubegpu_tpu.analysis.jaxpr_audit import compile_census
+    findings, summary = compile_census()
+    assert findings == [], "\n".join(f.message for f in findings)
+    assert summary["signatures_total"] == 12
+    for label in ("plain", "spec"):
+        eng = summary["engines"][label]
+        assert eng["observed"] == eng["expected"]
+        assert eng["total_first_compile_ms"] > 0

@@ -151,3 +151,21 @@ def test_serving_bench_smoke_parses_and_carries_keys():
     assert ft["host_ms_per_token_k4"] < ft["host_ms_per_token_k1"], \
         "fused ticks must shrink per-token host overhead"
     assert ft["host_overhead_reduction_x"] > 1.0
+
+    # compile-signature census (ISSUE 9): the scripted workload's
+    # distinct lowering-signature set must equal the enumerated
+    # expected set — zero violations — and the row must carry the
+    # signature count + first-compile ms per executable the driver's
+    # recompilation gate reads.
+    cc = doc["cb_compile_census"]
+    assert cc["violations"] == 0, cc["violation_messages"]
+    assert cc["signatures_total"] == 12
+    for name in ("decode_block", "decode_fused", "prefill_wave",
+                 "prefill_chunk", "adopt_wave", "activate_slot",
+                 "verify_block", "verify_fused"):
+        row = cc["per_executable"][name]
+        assert row["signatures"] >= 1, name
+        assert row["first_compile_ms"] > 0, name
+    for label in ("plain", "spec"):
+        assert cc["engines"][label]["observed"] == \
+            cc["engines"][label]["expected"]
