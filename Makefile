@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
-        fused-smoke analyze clean
+        fused-smoke hbm-smoke analyze clean
 
 all: native
 
@@ -51,6 +51,23 @@ fused-smoke: analyze            # ISSUE 8 fused multi-tick decode: K=4
 		tests/test_serve_chaos.py -q -k "Fused or fused"
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py -q
+
+hbm-smoke: analyze              # ISSUE 10 HBM-lean serving: donation
+	# on/off A/B (bit-exact, >=1.4x lower live pool bytes), compiled
+	# input_output_aliases covering every donated arg on the bf16 AND
+	# int8-KV engines, capacity headroom inside the old byte budget,
+	# plus the donated-handle hygiene suite (stale reads fail loudly).
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_page_pool.py -q -k "Donated or donat"
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -c "import json; \
+		from kubegpu_tpu.benchmark import run_serving_bench_smoke; \
+		row = run_serving_bench_smoke(legs=['cb_hbm_donation']); \
+		print(json.dumps(row, indent=1)); \
+		r = row['cb_hbm_donation']; \
+		assert r['bit_exact'] and r['aliases_covered']; \
+		assert r['pool_bytes_ratio'] >= 1.4, r['pool_bytes_ratio']"
 
 trace-smoke:                    # ISSUE 6 observability: a traced serve
 	# window must yield ONE connected span tree from extender bind

@@ -67,6 +67,14 @@ KTP006   attribute written under the class lock in one method but
          ``*_locked`` are caller-holds-lock by convention and count
          as locked.  Bless with the single-writer argument if one
          thread provably owns it.
+KTP007   serving executable without donation: inside the engine
+         factories (``_engine_fns`` / ``_paged_engine_fns``), every
+         jit-family wrap of a body that threads a ``pool``/``cache``
+         parameter must spell an explicit ``donate=`` — an
+         undeclared wrap keeps input AND output pool buffers live,
+         silently doubling steady-state KV HBM (ISSUE 10).  Bless
+         only with the why-not argument (a genuinely non-aliasable
+         layout).
 =======  =============================================================
 
 How to bless a site: prefer a ``[[bless]]`` entry in

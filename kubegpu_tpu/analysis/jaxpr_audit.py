@@ -253,6 +253,32 @@ def audit_engine_executables(blessings: Blessings | None = None):
     return findings, summary
 
 
+def donation_report(eng) -> dict:
+    """Compile-time proof that buffer donation holds (ISSUE 10): for
+    every executable of ``eng`` that mutates pool/cache/mirror state,
+    lower + compile it on :func:`representative_args` and check the
+    optimized HLO's ``input_output_alias`` header covers every leaf of
+    every donated argument (``parallel.sharding.donation_coverage``).
+    Returns ``{name: {"aliased_params", "covered", "args": ...}}`` —
+    the ``cb_hbm_donation`` bench row and ``test_bench_smoke`` assert
+    ``covered`` per executable so a refactor that silently voids
+    donation fails in tier-1, not as an HBM regression on hardware.
+    Lowering never executes, so the engine's own state is NOT donated
+    away by the report."""
+    from kubegpu_tpu.models.serve import PAGED_DONATED, DENSE_DONATED
+    from kubegpu_tpu.parallel.sharding import donation_coverage
+    donated = PAGED_DONATED if eng.paged else DENSE_DONATED
+    argsets = representative_args(eng)
+    report: dict = {}
+    for name, fn in zip(EXECUTABLES, eng._fns):
+        names = donated.get(name, ())
+        if fn is None or not names or name not in argsets:
+            continue
+        args, kw = argsets[name]
+        report[name] = donation_coverage(fn, args, names, static=kw)
+    return report
+
+
 # ------------------------------------------------------------- census
 
 def _sig_of(name: str, args, kwargs) -> str:

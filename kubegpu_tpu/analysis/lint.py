@@ -30,6 +30,12 @@ KTP006   inconsistent locking: an attribute a lock-owning class
          mutates under ``with self._lock`` in one method but bare in
          another — in a ``threading``-importing module that is a data
          race, not a style choice
+KTP007   serving executable without donation: inside the engine
+         factories (``_engine_fns`` / ``_paged_engine_fns``), a body
+         that threads a pool/cache argument must be wrapped with a
+         donation declaration (``donating_jit(..., donate=…)``) —
+         an undeclared wrap silently doubles steady-state KV HBM
+         (ISSUE 10)
 =======  ============================================================
 
 Sites are silenced via ``analysis/blessed_sites.toml`` or an inline
@@ -52,6 +58,7 @@ RULES = {
     "KTP004": "metric/span name missing from the METRICS TABLE",
     "KTP005": "unbounded list/dict growth in a long-lived class",
     "KTP006": "shared mutable state written without the class lock",
+    "KTP007": "serving executable threads pool/cache without donation",
 }
 
 # KTP002 applies to the device-code layers only — the host layers
@@ -236,6 +243,7 @@ class FileLinter:
         self._ktp005()
         if self.imports_threading:
             self._ktp006()
+        self._ktp007()
         return self.findings
 
     # -- KTP001: list.pop(0) -------------------------------------------
@@ -507,6 +515,71 @@ class FileLinter:
                                        | _EVICT_METHODS)):
             return _self_attr(node.func.value)
         return None
+
+
+    # -- KTP007: serving executables must declare donation -------------
+
+    _FNS_FACTORY_RE = re.compile(r"^_(paged_)?engine_fns$")
+    _POOL_PARAMS = {"pool", "cache"}
+    _DONATE_KEYS = {"donate", "donate_argnames", "donate_argnums"}
+    _JIT_WRAP_RE = re.compile(r"\b(donating_jit|sharded_jit|jit)\b")
+
+    def _ktp007(self) -> None:
+        """Census over the engine factories' construction sites: every
+        jit-family wrap (decorator or call) of a body that threads a
+        ``pool``/``cache`` parameter must carry an explicit donation
+        keyword.  The rule checks the SPELLING, not the runtime value —
+        ``donate=()`` (the A/B bench's donation-off engine) passes,
+        because the author decided; a wrap with no ``donate=`` at all
+        is the silent 2× HBM regression this rule exists to catch."""
+        for factory in ast.walk(self.tree):
+            if not (isinstance(factory, ast.FunctionDef)
+                    and self._FNS_FACTORY_RE.match(factory.name)):
+                continue
+            bodies = {
+                d.name: d for d in ast.walk(factory)
+                if isinstance(d, ast.FunctionDef) and d is not factory
+                and self._POOL_PARAMS & {a.arg for a in d.args.args}}
+            for name, d in bodies.items():
+                for dec in d.decorator_list:
+                    try:
+                        txt = ast.unparse(dec)
+                    except Exception:
+                        continue
+                    if not self._JIT_WRAP_RE.search(txt):
+                        continue
+                    keys = ({k.arg for k in dec.keywords}
+                            if isinstance(dec, ast.Call) else set())
+                    if not keys & self._DONATE_KEYS:
+                        self._emit(
+                            "KTP007", dec,
+                            f"serving executable '{name}' threads a "
+                            "pool/cache argument but its jit wrap "
+                            "declares no donation — wrap with "
+                            "donating_jit(..., donate=…) or bless "
+                            "with the why-not argument")
+            for node in ast.walk(factory):
+                if not isinstance(node, ast.Call):
+                    continue
+                try:
+                    target = ast.unparse(node.func)
+                except Exception:
+                    continue
+                if not self._JIT_WRAP_RE.search(target):
+                    continue
+                wrapped = [a.id for a in node.args
+                           if isinstance(a, ast.Name)
+                           and a.id in bodies]
+                if not wrapped:
+                    continue
+                if not {k.arg for k in node.keywords} \
+                        & self._DONATE_KEYS:
+                    self._emit(
+                        "KTP007", node,
+                        f"serving executable '{wrapped[0]}' threads a "
+                        f"pool/cache argument but this {target}() "
+                        "wrap declares no donation — pass donate=… "
+                        "or bless with the why-not argument")
 
 
 # -- KTP004: metric/span census against the documented registry --------

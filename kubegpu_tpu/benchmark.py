@@ -280,31 +280,36 @@ def _time_calls(fn, fetch, n: int) -> float:
 def _probe_block_cost(probe, iters: int) -> float:
     """Chained per-dispatch cost of a probe engine's decode block on
     its LIVE state (caller fills the probe's slots and steps once
-    first, so the paged kernel walks realistic page counts).  Consumes
-    the probe's pool/cache (the block donates it) — probes are
-    throwaway."""
+    first, so the paged kernel walks realistic page counts).
+
+    THE donate-the-pool protocol, shared by every probe below: the
+    engine's executables DONATE their pool/cache and mutated slot
+    mirrors (``donating_jit``, ISSUE 10), so a probe must chain every
+    donated argument through the measurement state — re-passing a live
+    handle after its first dispatch reads a deleted buffer.  Probes are
+    throwaway; consuming their state is the point."""
     import jax.numpy as jnp
 
     act = jnp.asarray(probe.active)
     if probe.paged:
-        st0 = (probe.pool, probe.tokens)
+        st0 = (probe.pool, probe.tokens, probe.pos)
 
         def chain(st):
-            pool, tok = st
-            _, tok, _, pool, _ = probe._fns[0](
+            pool, tok, pos = st
+            _, tok, pos, pool, _ = probe._fns[0](
                 probe.params, pool, probe._pt_dev, probe._tvec_dev,
-                probe._tpad_dev, tok, probe.pos, act, probe.temps,
+                probe._tpad_dev, tok, pos, act, probe.temps,
                 probe._base_key, jnp.int32(0))
-            return pool, tok
+            return pool, tok, pos
     else:
-        st0 = (probe.cache, probe.tokens)
+        st0 = (probe.cache, probe.tokens, probe.pos)
 
         def chain(st):
-            cache, tok = st
-            _, tok, _, cache, _ = probe._fns[0](
-                probe.params, cache, tok, probe.pos, act, probe.temps,
+            cache, tok, pos = st
+            _, tok, pos, cache, _ = probe._fns[0](
+                probe.params, cache, tok, pos, act, probe.temps,
                 probe._base_key, jnp.int32(0))
-            return cache, tok
+            return cache, tok, pos
 
     s, _ = _time_chained(chain, st0, iters=iters)
     return s
@@ -312,53 +317,51 @@ def _probe_block_cost(probe, iters: int) -> float:
 
 def _probe_wave_cost(probe, kwave: int, bucket: int, iters: int) -> float:
     """Per-dispatch admission cost (prefill + adopt) at one
-    (k, bucket), chained in this window on the probe's executables.
-    The adopt donates its big pool/cache, so the measurement chains
-    through a scratch copy."""
+    (k, bucket), chained in this window on the probe's executables
+    per the donate-the-pool protocol (see ``_probe_block_cost``): the
+    adopt donates its big pool/cache AND the four slot mirrors, so
+    the measurement chains all five through scratch copies — each
+    mirror gets its OWN buffer (donating one array through two
+    parameters is an aliasing error)."""
     import jax
     import jax.numpy as jnp
 
     qparams = probe.params
     paged = probe.paged
-    quant = paged and "k_scale" in probe.pool
     pf = probe._fns[1]
     slots = probe.n_slots
-    vec_i = jnp.zeros((slots,), jnp.int32)
-    vec_f = jnp.zeros((slots,), jnp.float32)
     padded = jnp.zeros((kwave, bucket), jnp.int32)
     lens = jnp.ones((kwave,), jnp.int32)
+    temps_w = jnp.zeros((kwave,), jnp.float32)
     pf_s = _time_calls(
-        lambda: pf(qparams, padded, lens, vec_f[:kwave],
+        lambda: pf(qparams, padded, lens, temps_w,
                    probe._base_key, jnp.int32(0))[0],
         lambda o: o, max((iters * 10) // kwave, 8))
-    firsts1, cache_w1 = pf(qparams, padded, lens, vec_f[:kwave],
+    firsts1, cache_w1 = pf(qparams, padded, lens, temps_w,
                            probe._base_key, jnp.int32(0))
     slotsk = jnp.arange(kwave, dtype=jnp.int32)
     big0 = jax.tree.map(jnp.zeros_like,
                         probe.pool if paged else probe.cache)
+    st_big = (big0,
+              jnp.zeros((slots,), jnp.int32),
+              jnp.zeros((slots,), jnp.int32),
+              jnp.zeros((slots,), jnp.int32),
+              jnp.zeros((slots,), jnp.float32))
     if paged:
         pdst = jnp.zeros((kwave, bucket // probe.page_size), jnp.int32)
 
         def adopt_chain(st):
-            new_ = probe._fns[2](
-                {"k": st[0], "v": st[1],
-                 **({"k_scale": st[2], "v_scale": st[3]}
-                    if quant else {})}, cache_w1, pdst, slotsk,
-                firsts1, lens, vec_f[:kwave], vec_i, vec_i, vec_i,
-                vec_f, kwave)[0]
-            return ((new_["k"], new_["v"], new_["k_scale"],
-                     new_["v_scale"]) if quant
-                    else (new_["k"], new_["v"]))
+            pool, ft, tok, pos, tmp = st
+            return probe._fns[2](
+                pool, cache_w1, pdst, slotsk, firsts1, lens,
+                temps_w, ft, tok, pos, tmp, kwave)
     else:
         def adopt_chain(st):
-            new_ = probe._fns[2](
-                {"k": st[0], "v": st[1]}, cache_w1, slotsk, firsts1,
-                lens, vec_f[:kwave], vec_i, vec_i, vec_i, vec_f,
-                kwave)[0]
-            return (new_["k"], new_["v"])
+            cache, ft, tok, pos, tmp = st
+            return probe._fns[2](
+                cache, cache_w1, slotsk, firsts1, lens,
+                temps_w, ft, tok, pos, tmp, kwave)
 
-    st_big = ((big0["k"], big0["v"], big0["k_scale"], big0["v_scale"])
-              if quant and paged else (big0["k"], big0["v"]))
     adopt_s, _ = _time_chained(adopt_chain, st_big,
                                iters=max(iters * 20, 20))
     return pf_s + adopt_s
@@ -367,8 +370,10 @@ def _probe_wave_cost(probe, kwave: int, bucket: int, iters: int) -> float:
 def _probe_chunk_cost(probe, bucket: int, iters: int) -> float:
     """Per-dispatch cost of one prefill chunk at near-max history (the
     last chunk of a ``bucket``-long prompt — the conservative upper
-    bound for the anchored stall figure).  Chains through a scratch
-    pool using the probe's live slot-0 page table."""
+    bound for the anchored stall figure).  Chains a scratch pool per
+    the donate-the-pool protocol (see ``_probe_block_cost``); the
+    chunk donates ONLY its pool, so the probe's live slot-0 page
+    table may be re-passed."""
     import jax
     import jax.numpy as jnp
 
@@ -437,6 +442,7 @@ def _train_draft_model(cfg, steps: int, pat_len: int, batch: int,
     import optax
 
     from kubegpu_tpu.models.llama import llama_init, make_train_step
+    from kubegpu_tpu.parallel.sharding import donating_jit
 
     rng = np.random.default_rng(seed)
     pattern = rng.integers(2, cfg.vocab_size, pat_len)
@@ -444,7 +450,8 @@ def _train_draft_model(cfg, steps: int, pat_len: int, batch: int,
     params = llama_init(jax.random.PRNGKey(seed), cfg)
     opt = optax.adamw(3e-4)
     state = opt.init(params)
-    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    step = donating_jit(make_train_step(cfg, opt),
+                        donate=("params", "opt_state"))
     loss = None
     for _ in range(steps):
         off = int(rng.integers(0, pat_len))
@@ -1336,90 +1343,17 @@ def _cb_ab_bench(qparams, cfg, slots: int, prompt: int, new: int,
                            waves=eng.prefill_waves,
                            wave_sizes=list(eng.wave_sizes))
         del eng  # its pool/cache is dead weight during the probe
-        # chained block rate: drive the probe's step() dispatch path
-        # directly via its jitted decode_block on its live state
-        if paged:
-            st0 = (probe.pool, probe.tokens)
-            act = jnp.asarray(probe.active)
-
-            def chain(st):
-                # device-resident tables (probe.step() uploaded them):
-                # re-uploading per call would re-add the very dispatch
-                # overhead the engine's dirty-tracking removed
-                pool, tok = st
-                _, tok, _, pool, _ = probe._fns[0](
-                    qparams, pool, probe._pt_dev, probe._tvec_dev,
-                    probe._tpad_dev, tok, probe.pos, act,
-                    probe.temps, probe._base_key, jnp.int32(0))
-                return pool, tok
-        else:
-            st0 = (probe.cache, probe.tokens)
-            act = jnp.asarray(probe.active)
-
-            def chain(st):
-                cache, tok = st
-                _, tok, _, cache, _ = probe._fns[0](
-                    qparams, cache, tok, probe.pos, act, probe.temps,
-                    probe._base_key, jnp.int32(0))
-                return cache, tok
-        blk_s, _ = _time_chained(chain, st0, iters=max(iters * 8, 8))
-        # per-wave admission cost (prefill + adopt), same protocol;
-        # the adopt (which donates its pool/cache) chains through the
-        # pool state so repeated calls stay valid
-        pf = probe._fns[1]
-        # admission cost measured at each WAVE SIZE the drain actually
-        # dispatched (max_wave defaults to 8, so waves are usually
-        # [k=8, k=8, ...]) — probing only k=1 would undercount the
-        # admission term ~7x.  Small ops need amplified bursts: at
-        # ~2-4 ms per call a 3-call burst sits under the tunnel's RTT
-        # jitter floor.
-        vec_i = jnp.zeros((slots,), jnp.int32)
-        vec_f = jnp.zeros((slots,), jnp.float32)
-        big0 = jax.tree.map(jnp.zeros_like,
-                            probe.pool if paged else probe.cache)
-        wave_cost_s: dict[int, float] = {}
-        for kwave in sorted(set(occ_scalars["wave_sizes"])):
-            padded = jnp.zeros((kwave, prompt), jnp.int32)
-            lens = jnp.ones((kwave,), jnp.int32)
-            pf_s = _time_calls(
-                lambda: pf(qparams, padded, lens, vec_f[:kwave],
-                           probe._base_key, jnp.int32(0))[0],
-                lambda o: o, max((iters * 10) // kwave, 8))
-            firsts1, cache_w1 = pf(qparams, padded, lens,
-                                   vec_f[:kwave], probe._base_key,
-                                   jnp.int32(0))
-            slotsk = jnp.arange(kwave, dtype=jnp.int32)
-            if paged:
-                pdst = jnp.zeros((kwave, prompt // page), jnp.int32)
-
-                def adopt_chain(st):
-                    new_ = probe._fns[2](
-                        {"k": st[0], "v": st[1],
-                         **({"k_scale": st[2], "v_scale": st[3]}
-                            if quant else {})}, cache_w1, pdst,
-                        slotsk, firsts1, lens, vec_f[:kwave], vec_i,
-                        vec_i, vec_i, vec_f, kwave)[0]
-                    return ((new_["k"], new_["v"], new_["k_scale"],
-                             new_["v_scale"]) if quant
-                            else (new_["k"], new_["v"]))
-            else:
-                def adopt_chain(st):
-                    new_ = probe._fns[2](
-                        {"k": st[0], "v": st[1]}, cache_w1, slotsk,
-                        firsts1, lens, vec_f[:kwave], vec_i, vec_i,
-                        vec_i, vec_f, kwave)[0]
-                    return (new_["k"], new_["v"])
-            st_big = ((big0["k"], big0["v"], big0["k_scale"],
-                       big0["v_scale"]) if quant and paged
-                      else (big0["k"], big0["v"]))
-            adopt_s, st_big = _time_chained(
-                adopt_chain, st_big, iters=max(iters * 20, 20))
-            if quant and paged:
-                big0 = {"k": st_big[0], "v": st_big[1],
-                        "k_scale": st_big[2], "v_scale": st_big[3]}
-            else:
-                big0 = {"k": st_big[0], "v": st_big[1]}
-            wave_cost_s[kwave] = pf_s + adopt_s
+        # chained block rate on the probe's jitted decode_block, then
+        # per-wave admission cost (prefill + adopt) — both via the
+        # shared probe helpers, which own the donate-the-pool chaining
+        # protocol (see _probe_block_cost).  Admission is measured at
+        # each WAVE SIZE the drain actually dispatched (max_wave
+        # defaults to 8, so waves are usually [k=8, k=8, ...]) —
+        # probing only k=1 would undercount the admission term ~7x.
+        blk_s = _probe_block_cost(probe, max(iters * 8, 8))
+        wave_cost_s = {
+            kwave: _probe_wave_cost(probe, kwave, prompt, iters)
+            for kwave in sorted(set(occ_scalars["wave_sizes"]))}
         anchored_s = ticks * blk_s + sum(
             wave_cost_s[k_] for k_ in occ_scalars["wave_sizes"])
         return {
@@ -1486,6 +1420,7 @@ def _families_bench(cfg, params, on_tpu) -> dict:
     from kubegpu_tpu.models.quant import quantize_llama
     from kubegpu_tpu.models.t5 import t5_greedy_generate, t5_init
     from kubegpu_tpu.models.t5 import T5Config
+    from kubegpu_tpu.parallel.sharding import donating_jit
 
     if on_tpu:
         moe_cfg = moe_bench_config()
@@ -1618,8 +1553,8 @@ def _families_bench(cfg, params, on_tpu) -> dict:
     adapters = lora_init(jax.random.PRNGKey(3), params, lcfg)
     opt = optax.adamw(1e-3)
     lora_opt_state = opt.init(adapters)
-    lora_step = jax.jit(make_lora_train_step(cfg, lcfg, opt),
-                       donate_argnums=(0, 1))
+    lora_step = donating_jit(make_lora_train_step(cfg, lcfg, opt),
+                             donate=("adapters", "opt_state"))
     toks = jnp.asarray(
         np.arange(lora_batch * seq).reshape(lora_batch, seq)
         % cfg.vocab_size, jnp.int32)
@@ -1743,7 +1678,8 @@ def _families_bench(cfg, params, on_tpu) -> dict:
     tparams = llama_init(jax.random.PRNGKey(7), cfg)
     opt = optax.adamw(3e-4)
     tstate = opt.init(tparams)
-    tstep = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    tstep = donating_jit(make_train_step(cfg, opt),
+                         donate=("params", "opt_state"))
     t_train0 = time.perf_counter()
     loss = None
     for i in range(pld_steps):
@@ -1909,6 +1845,7 @@ def run_model_bench(steps: int = 12) -> dict:
 
     from kubegpu_tpu.models import LlamaConfig, llama_init
     from kubegpu_tpu.models.llama import make_train_step
+    from kubegpu_tpu.parallel.sharding import donating_jit
 
     dev = jax.devices()[0]
     on_tpu = dev.platform.startswith(("tpu", "axon"))
@@ -1924,7 +1861,8 @@ def run_model_bench(steps: int = 12) -> dict:
     # donate the train state: without aliasing, XLA keeps input AND
     # output copies of params+adamw moments live across the step — at
     # this model size that alone OOMs a 16 GiB chip
-    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    step = donating_jit(make_train_step(cfg, opt),
+                        donate=("params", "opt_state"))
     tokens = jnp.asarray(
         (np.arange(batch * seq).reshape(batch, seq))
         % cfg.vocab_size, jnp.int32)
@@ -1974,12 +1912,105 @@ def run_model_bench(steps: int = 12) -> dict:
     return out
 
 
-def run_serving_bench_smoke() -> dict:
+def _cb_hbm_bench(params, cfg, slots: int, prompt: int, new: int,
+                  stride: int, page: int, reqs: int) -> dict:
+    """Donation-on/off HBM A/B in one window (ISSUE 10): the same
+    request mix through two otherwise-identical paged engines, one
+    with buffer donation (the default), one without.  Asserts nothing
+    itself — reports what the tier-1 smoke asserts: bit-exact tokens,
+    the steady-state live-pool byte ratio (donation halves it: the
+    non-donating engine keeps input AND output pool buffers live
+    across each tick), compile-time ``input_output_alias`` coverage
+    for every mutated pool/cache/mirror argument of every executable
+    on BOTH audit engines (bf16 spec + int8-KV — the int8 check is
+    what proves QTensor scales alias alongside values), and the
+    capacity headroom: the larger ``max_pages``/``n_slots`` that now
+    fits the byte budget the non-donating engine needed, demonstrated
+    by actually running the bigger engine inside that budget."""
+    import jax
+    import numpy as np
+
+    from kubegpu_tpu.analysis.jaxpr_audit import (
+        build_audit_engine,
+        donation_report,
+    )
+    from kubegpu_tpu.models.serve import ContinuousBatcher
+
+    cb_p = np.arange(prompt) % cfg.vocab_size
+
+    def run(donate: bool, n_slots=slots, total_pages=None,
+            n_reqs=reqs):
+        eng = ContinuousBatcher(
+            params, cfg, n_slots=n_slots, stride=stride,
+            prompt_buckets=(prompt,), paged=True, page_size=page,
+            total_pages=total_pages, donate=donate)
+        for i in range(n_reqs):
+            eng.submit((cb_p + i) % cfg.vocab_size, new)
+        done = eng.drain()
+        toks = {r.rid: list(r.tokens) for r in done}
+        return toks, eng
+
+    on_toks, on_eng = run(True)
+    off_toks, off_eng = run(False)
+    pool_bytes = sum(h.nbytes for h in jax.tree.leaves(on_eng.pool))
+    ratio = off_eng.hbm_peak_bytes / max(on_eng.hbm_peak_bytes, 1)
+
+    # compile-time aliasing proof — per executable, per engine flavor
+    aliases = {}
+    for label, kw in (("bf16", dict(spec=True)),
+                      ("int8", dict(kv_int8=True))):
+        rep = donation_report(build_audit_engine(**kw))
+        aliases[label] = {
+            name: {"aliased_params": r["aliased_params"],
+                   "covered": r["covered"],
+                   "args": {a: f"{d['aliased']}/{d['leaves']}"
+                            for a, d in r["args"].items()}}
+            for name, r in rep.items()}
+
+    # capacity-headroom sweep: the byte budget is what the NON-donating
+    # engine peaked at for this shape; donation frees the input-copy
+    # half, so ~ratio× the pages (and another slot's mirrors) fit back
+    # in.  Run the bigger engine for real — a projection alone would
+    # hide a pool-layout bug that breaks at the larger shape.
+    budget = off_eng.hbm_peak_bytes
+    big_pages = int(on_eng.total_pages * ratio)
+    big_toks, big_eng = run(True, n_slots=slots + 1,
+                            total_pages=big_pages, n_reqs=reqs + 1)
+    return {
+        "bit_exact": on_toks == off_toks,
+        "tokens": sum(len(t) for t in on_toks.values()),
+        "pool_bytes": pool_bytes,
+        "donation_on": {"live_bytes": on_eng.hbm_pool_bytes,
+                        "peak_bytes": on_eng.hbm_peak_bytes,
+                        "samples": on_eng.hbm.samples},
+        "donation_off": {"live_bytes": off_eng.hbm_pool_bytes,
+                         "peak_bytes": off_eng.hbm_peak_bytes},
+        "pool_bytes_ratio": round(ratio, 3),
+        "input_output_aliases": aliases,
+        "aliases_covered": all(
+            r["covered"] and r["aliased_params"] > 0
+            for rep_ in aliases.values() for r in rep_.values()),
+        "capacity_headroom": {
+            "byte_budget": budget,
+            "total_pages_no_donation": on_eng.total_pages,
+            "total_pages_donation": big_pages,
+            "n_slots_no_donation": slots,
+            "n_slots_donation": slots + 1,
+            "bigger_engine_peak_bytes": big_eng.hbm_peak_bytes,
+            "fits_budget": big_eng.hbm_peak_bytes <= budget,
+            "tokens": sum(len(t) for t in big_toks.values()),
+        },
+    }
+
+
+def run_serving_bench_smoke(legs=None) -> dict:
     """Tiny-config run of ONLY the serving fast-path bench legs
-    (prefix cache, chunked-prefill stall, equal-HBM mixed-length A/B)
-    — seconds on CPU.  ``make bench-smoke`` and the tier-1 smoke test
-    drive this to assert the bench JSON parses and carries the new
-    keys without waiting for a full hardware bench."""
+    (prefix cache, chunked-prefill stall, equal-HBM mixed-length A/B,
+    HBM donation A/B) — seconds on CPU.  ``make bench-smoke`` and the
+    tier-1 smoke test drive this to assert the bench JSON parses and
+    carries the new keys without waiting for a full hardware bench.
+    ``legs`` filters to a subset by row name (``make hbm-smoke`` runs
+    just ``cb_hbm_donation``)."""
     import jax
 
     from kubegpu_tpu.models import LlamaConfig, llama_init
@@ -1999,40 +2030,52 @@ def run_serving_bench_smoke() -> dict:
     # the measured acceptance (1.0 on the learned cycle) the spec
     # engine drains the window in FEWER verify ticks than the off
     # engine's decode blocks — deterministic, so tier-1 asserts it.
-    sp_cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=2, n_layers=4,
-                              max_seq_len=64)
-    sp_params, sp_pattern, _ = _train_draft_model(
-        sp_cfg, steps=100, pat_len=8, batch=2, seq=16)
-    sp_cyc = np.tile(sp_pattern, 6)
-    return {
-        "cb_prefix_cache": _cb_prefix_bench(
-            params, cfg, slots=2, prompt=16, new=4, stride=2, page=8,
-            n_way=3),
-        "cb_chunked_stall": _cb_stall_bench(
-            params, cfg, slots=2, prompt=16, new=4, stride=2, reqs=3,
-            page=8, chunk=8, iters=2),
-        "cb_equal_hbm": _cb_equal_hbm_bench(
-            params, cfg, dense_slots=2, paged_slots=3, buckets=(8, 16),
-            mix=[(8, 3), (16, 3)], reqs=4, stride=2, page=8, iters=2),
-        "cb_tp_scaling": _cb_tp_bench(
-            tp_params, tp_cfg, slots=2, prompt=16, new=4, stride=2,
-            reqs=6, page=8, iters=2),
-        "cb_spec": _cb_spec_bench(
+    def spec_leg():
+        sp_cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=2, n_layers=4,
+                                  max_seq_len=64)
+        sp_params, sp_pattern, _ = _train_draft_model(
+            sp_cfg, steps=100, pat_len=8, batch=2, seq=16)
+        sp_cyc = np.tile(sp_pattern, 6)
+        return _cb_spec_bench(
             sp_params, sp_cfg, slots=2, prompt=16, new=8, stride=2,
             page=8, reqs=4, iters=2, draft_layers=2, gammas=(3,),
             degrees=(1, 2),
-            prompts=[sp_cyc[i % 8:][:16] for i in range(4)]),
-        "cb_chaos": _cb_chaos_bench(
+            prompts=[sp_cyc[i % 8:][:16] for i in range(4)])
+
+    rows = {
+        "cb_prefix_cache": lambda: _cb_prefix_bench(
+            params, cfg, slots=2, prompt=16, new=4, stride=2, page=8,
+            n_way=3),
+        "cb_chunked_stall": lambda: _cb_stall_bench(
+            params, cfg, slots=2, prompt=16, new=4, stride=2, reqs=3,
+            page=8, chunk=8, iters=2),
+        "cb_equal_hbm": lambda: _cb_equal_hbm_bench(
+            params, cfg, dense_slots=2, paged_slots=3, buckets=(8, 16),
+            mix=[(8, 3), (16, 3)], reqs=4, stride=2, page=8, iters=2),
+        "cb_tp_scaling": lambda: _cb_tp_bench(
+            tp_params, tp_cfg, slots=2, prompt=16, new=4, stride=2,
+            reqs=6, page=8, iters=2),
+        "cb_spec": spec_leg,
+        "cb_chaos": lambda: _cb_chaos_bench(
             params, cfg, slots=2, prompt=16, new=8, stride=2, page=8,
             reqs=6),
-        "cb_trace_overhead": _cb_trace_overhead_bench(
+        "cb_trace_overhead": lambda: _cb_trace_overhead_bench(
             params, cfg, slots=2, prompt=16, new=8, stride=2, page=8,
             reqs=6),
-        "cb_fused_ticks": _cb_fused_bench(
+        "cb_fused_ticks": lambda: _cb_fused_bench(
             params, cfg, slots=3, prompt=16, new=24, stride=2, page=8,
             reqs=3, ks=(1, 4)),
-        "cb_compile_census": _cb_compile_census_bench(),
+        "cb_hbm_donation": lambda: _cb_hbm_bench(
+            params, cfg, slots=2, prompt=16, new=8, stride=2, page=8,
+            reqs=4),
+        "cb_compile_census": _cb_compile_census_bench,
     }
+    if legs is not None:
+        unknown = set(legs) - set(rows)
+        if unknown:
+            raise ValueError(f"unknown bench legs: {sorted(unknown)}")
+        rows = {k: rows[k] for k in rows if k in set(legs)}
+    return {name: fn() for name, fn in rows.items()}
 
 
 def _cb_compile_census_bench() -> dict:

@@ -118,6 +118,37 @@ from kubegpu_tpu.obs.chaos import (
     TickStallError,
 )
 from kubegpu_tpu.ops.flash_attention import NEG_INF
+from kubegpu_tpu.parallel.sharding import donating_jit
+
+
+# ---------------------------------------------------------------------------
+# Buffer donation (the HBM-lean serving contract)
+# ---------------------------------------------------------------------------
+# Every executable donates the arguments the engine rebinds from its
+# outputs each dispatch — the page pool / dense cache AND the per-slot
+# device mirrors — so XLA aliases output buffers onto input buffers
+# instead of holding both live (2× steady-state KV HBM without it).
+# These tables are the single source of truth: the engine fns wrap
+# through donating_jit with exactly these names, donation_report()
+# verifies the compiled input_output_aliases cover them, and the
+# cb_hbm_donation bench A/Bs them off via the ``donate`` knob.
+
+PAGED_DONATED = {
+    "decode_block": ("pool", "tokens", "pos"),
+    "prefill_wave": (),
+    "adopt_wave": ("pool", "first_toks", "tokens", "pos", "temps"),
+    "prefill_chunk": ("pool",),
+    "activate_slot": ("first_toks", "tokens", "pos", "temps"),
+    "verify_block": ("pool", "tokens", "pos"),
+    "decode_fused": ("pool", "tokens", "pos"),
+    "verify_fused": ("pool", "tokens", "pos"),
+}
+
+DENSE_DONATED = {
+    "decode_block": ("cache", "tokens", "pos"),
+    "prefill_wave": (),
+    "adopt_wave": ("cache", "first_toks", "tokens", "pos", "temps"),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -353,7 +384,7 @@ def _flush_buffer(cache: dict, buf: dict, flush_pos: jax.Array) -> dict:
 @functools.lru_cache(maxsize=32)
 def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
                 stride: int, top_k: int = 0, sampling: bool = False,
-                ffn_factory=None, ffn_cfg=None):
+                ffn_factory=None, ffn_cfg=None, donate: bool = True):
     """Jitted engine pieces, cached per static signature.  ``top_k``
     is the engine-wide truncation for sampled slots (static: per-slot
     k would be shape-dynamic); per-REQUEST temperature rides a [B]
@@ -369,7 +400,10 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
     def _pick(logits, temps, k_):
         return _pick_token(logits, temps, k_, top_k, sampling)
 
-    @functools.partial(jax.jit, donate_argnames=("cache",))
+    def _don(name):
+        return DENSE_DONATED[name] if donate else ()
+
+    @functools.partial(donating_jit, donate=_don("decode_block"))
     def decode_block(params, cache, tokens, pos, active, temps,
                      base_key, tick):
         """``stride`` decode steps for all slots in ONE dispatch.
@@ -413,7 +447,7 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
         cache = _flush_buffer(cache, buf, flush_pos)
         return block, tokens, pos, cache, bad.astype(jnp.int32)
 
-    @jax.jit
+    @donating_jit
     def prefill_wave(params, padded_prompts, true_lens, temps_w,
                      base_key, rid0):
         """Batch-k prefill on right-padded prompts [k, bucket] (the
@@ -434,8 +468,8 @@ def _engine_fns(cfg: LlamaConfig, n_slots: int, max_len: int,
         key = jax.random.fold_in(jax.random.fold_in(base_key, 1), rid0)
         return _pick(last, temps_w, key).astype(jnp.int32), cache_w
 
-    @functools.partial(jax.jit, static_argnames=("k",),
-                       donate_argnames=("cache",))
+    @functools.partial(donating_jit, donate=_don("adopt_wave"),
+                       static=("k",))
     def adopt_wave(cache, cache_w, slots, firsts, plens, temps_w,
                    first_toks, tokens, pos, temps, k):
         """Admit a whole wave in ONE dispatch: scatter the batch-k
@@ -552,7 +586,8 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                       ffn_cfg=None, mesh=None,
                       quant_weights: bool = False,
                       spec_gamma: int = 0, draft_layers: int = 0,
-                      fused_k: int = 0, eos_id: int = -1):
+                      fused_k: int = 0, eos_id: int = -1,
+                      donate: bool = True):
     """Jitted engine pieces for the PAGED cache mode: the KV history
     lives in a page pool [L, n_pages, Hkv, P, D] shared by all slots
     (page 0 is a trash page, never allocated), addressed through a
@@ -601,6 +636,9 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
 
     def _pick(logits, temps, k_):
         return _pick_token(logits, temps, k_, top_k, sampling)
+
+    def don(name):
+        return PAGED_DONATED[name] if donate else ()
 
     def _block_body(params, pool, pt, tvec, tpad, tokens, pos, active,
                     temps, base_key, tick):
@@ -844,13 +882,15 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         tok = _pick(logits, temps1, key).astype(jnp.int32)
         return tok, pool
 
-    @jax.jit
+    @functools.partial(donating_jit, donate=don("activate_slot"))
     def activate_slot(first_toks, tokens, pos, temps, slot, tok,
                       plen, temp):
         """Flip a chunk-prefilled slot live in ONE dispatch (the
         chunk-path analog of adopt_wave's vector updates).  Pure
         replicated vector math — needs no shard_map even under tp
-        (every input is replicated; jit runs it SPMD on the mesh)."""
+        (every input is replicated; jit runs it SPMD on the mesh).
+        The four slot mirrors are donated: the engine rebinds all of
+        them from the outputs."""
         first_toks = lax.dynamic_update_slice(first_toks, tok, (slot,))
         tokens = lax.dynamic_update_slice(tokens, tok, (slot,))
         pos = lax.dynamic_update_slice(pos, plen, (slot,))
@@ -1150,36 +1190,34 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                     pool, stall.astype(jnp.int32)
 
     if mesh is None:
-        decode_block = functools.partial(
-            jax.jit, donate_argnames=("pool",))(_block_body)
-        prefill_wave = jax.jit(_pw_body)
-        adopt_wave = functools.partial(
-            jax.jit, static_argnames=("k",),
-            donate_argnames=("pool",))(_adopt_body)
-        prefill_chunk = functools.partial(
-            jax.jit, donate_argnames=("pool",))(_chunk_body)
-        verify_block = (functools.partial(
-            jax.jit, donate_argnames=("pool",))(_spec_body)
-            if _spec_body is not None else None)
-        decode_fused = (functools.partial(
-            jax.jit, donate_argnames=("pool",))(_fused_body)
-            if _fused_body is not None else None)
-        verify_fused = (functools.partial(
-            jax.jit, donate_argnames=("pool",))(_fused_spec_body)
-            if _fused_spec_body is not None else None)
+        decode_block = donating_jit(_block_body,
+                                    donate=don("decode_block"))
+        prefill_wave = donating_jit(_pw_body)
+        adopt_wave = donating_jit(_adopt_body,
+                                  donate=don("adopt_wave"),
+                                  static=("k",))
+        prefill_chunk = donating_jit(_chunk_body,
+                                     donate=don("prefill_chunk"))
+        verify_block = (donating_jit(_spec_body,
+                                     donate=don("verify_block"))
+                        if _spec_body is not None else None)
+        decode_fused = (donating_jit(_fused_body,
+                                     donate=don("decode_fused"))
+                        if _fused_body is not None else None)
+        verify_fused = (donating_jit(_fused_spec_body,
+                                     donate=don("verify_fused"))
+                        if _fused_spec_body is not None else None)
         return decode_block, prefill_wave, adopt_wave, prefill_chunk, \
             activate_slot, verify_block, decode_fused, verify_fused
 
     # -- mesh-native wrapping (shard_map over the tp axis) --------------
-    # replication checking off: pallas_call has no replication rule;
-    # every replicated output here is replicated by construction
-    # (identical math on identical operands, post-all-gather).
-    import functools as _ft
-
+    # donating_jit composes the shard_map (replication checking off:
+    # pallas_call has no replication rule; every replicated output here
+    # is replicated by construction — identical math on identical
+    # operands, post-all-gather) with the donation the engine's rebind
+    # contract expects; the pool's shards alias in place per chip.
     from jax.sharding import PartitionSpec as P
 
-    from kubegpu_tpu.parallel.sharding import compat_shard_map
-    shard_map = _ft.partial(compat_shard_map, check=False)
     rep = P()
     kvspec = P(None, None, "tp", None, None)
     pool_spec = {"k": kvspec, "v": kvspec}
@@ -1189,74 +1227,47 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
     cache_spec = {"k": kvspec, "v": kvspec}   # prefill panel: model dtype
     pspec = _serve_param_specs(quant_weights)
 
-    _sm_block = shard_map(
-        _block_body, mesh=mesh,
+    decode_block = donating_jit(
+        _block_body, donate=don("decode_block"), mesh=mesh,
         in_specs=(pspec, pool_spec) + (rep,) * 9,
         out_specs=(rep, rep, rep, pool_spec, rep))
 
-    @functools.partial(jax.jit, donate_argnames=("pool",))
-    def decode_block(params, pool, pt, tvec, tpad, tokens, pos, active,
-                     temps, base_key, tick):
-        return _sm_block(params, pool, pt, tvec, tpad, tokens, pos,
-                         active, temps, base_key, tick)
-
-    prefill_wave = jax.jit(shard_map(
+    prefill_wave = donating_jit(
         _pw_body, mesh=mesh, in_specs=(pspec,) + (rep,) * 5,
-        out_specs=(rep, cache_spec)))
+        out_specs=(rep, cache_spec))
 
-    @functools.partial(jax.jit, static_argnames=("k",),
-                       donate_argnames=("pool",))
-    def adopt_wave(pool, cache_w, page_dst, slots, firsts, plens,
-                   temps_w, first_toks, tokens, pos, temps, k):
-        fn = shard_map(
-            functools.partial(_adopt_body, k=k), mesh=mesh,
-            in_specs=(pool_spec, cache_spec) + (rep,) * 9,
-            out_specs=(pool_spec,) + (rep,) * 4)
-        return fn(pool, cache_w, page_dst, slots, firsts, plens,
-                  temps_w, first_toks, tokens, pos, temps)
+    adopt_wave = donating_jit(
+        _adopt_body, donate=don("adopt_wave"), static=("k",),
+        mesh=mesh, in_specs=(pool_spec, cache_spec) + (rep,) * 9,
+        out_specs=(pool_spec,) + (rep,) * 4)
 
-    _sm_chunk = shard_map(
-        _chunk_body, mesh=mesh,
+    prefill_chunk = donating_jit(
+        _chunk_body, donate=don("prefill_chunk"), mesh=mesh,
         in_specs=(pspec, pool_spec) + (rep,) * 7,
         out_specs=(rep, pool_spec))
-
-    @functools.partial(jax.jit, donate_argnames=("pool",))
-    def prefill_chunk(params, pool, chunk, pt_row, s, tlen, temps1,
-                      base_key, rid):
-        return _sm_chunk(params, pool, chunk, pt_row, s, tlen, temps1,
-                         base_key, rid)
 
     verify_block = None
     if _spec_body is not None:
         # the draft weights shard under the SAME per-leaf spec tree as
         # the full model (a draft_view shares/slices the same leaves);
         # everything else replicates like the decode block's inputs
-        _sm_spec = shard_map(
-            _spec_body, mesh=mesh,
+        verify_block = donating_jit(
+            _spec_body, donate=don("verify_block"), mesh=mesh,
             in_specs=(pspec, pspec, pool_spec) + (rep,) * 7,
             out_specs=(rep,) * 6 + (pool_spec,))
 
-        @functools.partial(jax.jit, donate_argnames=("pool",))
-        def verify_block(params, dparams, pool, pt, tvec, tpad, tokens,
-                         pos, active, gcap):
-            return _sm_spec(params, dparams, pool, pt, tvec, tpad,
-                            tokens, pos, active, gcap)
-
-    from kubegpu_tpu.parallel.sharding import sharded_jit
     decode_fused = None
     verify_fused = None
     if _fused_body is not None:
-        decode_fused = sharded_jit(
-            _fused_body, mesh,
+        decode_fused = donating_jit(
+            _fused_body, donate=don("decode_fused"), mesh=mesh,
             in_specs=(pspec, pool_spec) + (rep,) * 11,
-            out_specs=(rep, rep, rep, pool_spec, rep, rep),
-            donate=("pool",))
+            out_specs=(rep, rep, rep, pool_spec, rep, rep))
     if _fused_spec_body is not None:
-        verify_fused = sharded_jit(
-            _fused_spec_body, mesh,
+        verify_fused = donating_jit(
+            _fused_spec_body, donate=don("verify_fused"), mesh=mesh,
             in_specs=(pspec, pspec, pool_spec) + (rep,) * 9,
-            out_specs=(rep,) * 6 + (pool_spec, rep),
-            donate=("pool",))
+            out_specs=(rep,) * 6 + (pool_spec, rep))
 
     return decode_block, prefill_wave, adopt_wave, prefill_chunk, \
         activate_slot, verify_block, decode_fused, verify_fused
@@ -1369,7 +1380,8 @@ class ContinuousBatcher:
                  spec_degrade_after: int | None = None,
                  debug_invariants: bool = False,
                  tracer=None, trace_ctx=None,
-                 fused_ticks: int = 1, eos_id: int | None = None):
+                 fused_ticks: int = 1, eos_id: int | None = None,
+                 donate: bool = True):
         # model families: a MoEConfig serves through the same engine —
         # its Llama backbone drives attention/cache shapes, the routed
         # expert FFN rides the engine's ffn hook (VERDICT r4 weak #6:
@@ -1540,7 +1552,8 @@ class ContinuousBatcher:
                 draft_layers=self.draft_layers,
                 fused_k=(self.fused_ticks if self.fused_ticks > 1
                          else 0),
-                eos_id=-1 if eos_id is None else int(eos_id))
+                eos_id=-1 if eos_id is None else int(eos_id),
+                donate=bool(donate))
             shape = (cfg.n_layers, self.total_pages + 1, cfg.n_kv_heads,
                      page_size, cfg.head_dim)
             if kv_int8:
@@ -1632,7 +1645,8 @@ class ContinuousBatcher:
             self._fns = _engine_fns(cfg, n_slots, self.max_len, stride,
                                     top_k, sampling,
                                     ffn_factory=ffn_factory,
-                                    ffn_cfg=ffn_cfg)
+                                    ffn_cfg=ffn_cfg,
+                                    donate=bool(donate))
             self.cache = init_kv_cache(cfg, n_slots, self.max_len)
             self.prefix_cache_enabled = False
             self.chunked_prefill = False
@@ -1660,6 +1674,32 @@ class ContinuousBatcher:
         # decode steps; the naive per-admission int() sync dominated
         # the first on-chip measurement)
         self.first_toks = jnp.zeros((n_slots,), jnp.int32)
+        self._donate = bool(donate)
+        if mesh is not None:
+            # replicate the slot mirrors ONCE: a donating executable
+            # can only alias an input already laid out like its
+            # output — an uncommitted single-device mirror would be
+            # resharded at dispatch (a copy) and its donation
+            # silently dropped
+            from jax.sharding import PartitionSpec as _P
+
+            from kubegpu_tpu.parallel.sharding import device_put_tree
+            (self.tokens, self.pos, self.temps,
+             self.first_toks) = device_put_tree(
+                mesh, (self.tokens, self.pos, self.temps,
+                       self.first_toks), (_P(),) * 4)
+        # live-byte accounting + donated-handle hygiene (HBM-lean
+        # serving): around each donating dispatch the engine
+        # snapshots the handles it is about to donate, samples how
+        # many pool/mirror bytes are REALLY live right after
+        # (donation-on: inputs already deleted, 1x the pool;
+        # donation-off: input and output both live, 2x), and — the
+        # debug guard — force-deletes any stale input handle a
+        # backend left undeleted, so a leaked reference fails loudly
+        # (RuntimeError: Array has been deleted) instead of silently
+        # pinning pool-sized garbage
+        from kubegpu_tpu.obs.metrics import LiveBytesTracker
+        self.hbm = LiveBytesTracker(metrics)
         self.slot_req: dict[int, _Request] = {}
         self.queue: deque[tuple[_Request, jax.Array]] = deque()
         self._inflight: jax.Array | None = None   # fused (block, firsts)
@@ -1799,44 +1839,49 @@ class ContinuousBatcher:
         mid-measurement (observed eating ~95% of a flagship run)."""
         decode_block, prefill_wave, adopt_wave = self._fns[:3]
         outs = []
-        # Every executable DONATES its big KV argument, so warmup
-        # chains a scratch pool/cache through the calls and never
-        # touches the live one (donating it would invalidate it).
+        # Every executable DONATES its big KV argument AND the slot
+        # mirrors it rebinds, so warmup chains scratch copies of ALL
+        # of them through the calls and never touches the live state
+        # (donating a live array would invalidate the engine).
         scratch = jax.tree.map(
             jnp.zeros_like, self.pool if self.paged else self.cache)
+        sft = jnp.zeros_like(self.first_toks)
+        stok = jnp.zeros_like(self.tokens)
+        spos = jnp.zeros_like(self.pos)
+        stmp = jnp.zeros_like(self.temps)
 
-        def adopt(scratch, cache_w, k, bucket, firsts, lens, temps):
+        def adopt(scratch, sft, stok, spos, stmp, cache_w, k, bucket,
+                  firsts, lens, temps):
             common = (jnp.arange(k, dtype=jnp.int32), firsts, lens,
-                      temps, self.first_toks, self.tokens, self.pos,
-                      self.temps, k)
+                      temps, sft, stok, spos, stmp, k)
             if self.paged:
                 page_dst = jnp.zeros(
                     (k, bucket // self.page_size), jnp.int32)
                 return adopt_wave(scratch, cache_w, page_dst, *common)
             return adopt_wave(scratch, cache_w, *common)
 
-        def block(scratch):
+        def block(scratch, stok, spos, stmp):
             if self.paged and self.spec_gamma:
                 # the spec engine never dispatches the decode block —
                 # its hot executable is the verify tick
                 out = self._fns[5](
                     self.params, self._draft_params, scratch,
                     jnp.asarray(self._pt), jnp.asarray(self._tvec),
-                    jnp.asarray(self._tpad), self.tokens, self.pos,
+                    jnp.asarray(self._tpad), stok, spos,
                     jnp.asarray(self.active), jnp.asarray(self._gcap))
-                return out[0], out[6]
+                return out[0], out[6], out[4], out[5]
             if self.paged:
                 out = decode_block(
                     self.params, scratch, jnp.asarray(self._pt),
                     jnp.asarray(self._tvec), jnp.asarray(self._tpad),
-                    self.tokens, self.pos, jnp.asarray(self.active),
-                    self.temps, self._base_key, jnp.int32(0))
+                    stok, spos, jnp.asarray(self.active),
+                    stmp, self._base_key, jnp.int32(0))
             else:
                 out = decode_block(
-                    self.params, scratch, self.tokens, self.pos,
-                    jnp.asarray(self.active), self.temps,
+                    self.params, scratch, stok, spos,
+                    jnp.asarray(self.active), stmp,
                     self._base_key, jnp.int32(0))
-            return out[0], out[3]
+            return out[0], out[3], out[1], out[2]
 
         for bucket in self.prompt_buckets:
             k = 1
@@ -1847,9 +1892,10 @@ class ContinuousBatcher:
                 firsts, cache_w = prefill_wave(
                     self.params, padded, lens, temps,
                     self._base_key, jnp.int32(0))
-                scratch, ft, *_ = adopt(scratch, cache_w, k, bucket,
-                                        firsts, lens, temps)
-                outs.append(ft)
+                scratch, sft, stok, spos, stmp = adopt(
+                    scratch, sft, stok, spos, stmp, cache_w, k,
+                    bucket, firsts, lens, temps)
+                outs.append(firsts)
                 k *= 2
         if self.paged and (self.prefix_cache_enabled
                            or self.chunked_prefill):
@@ -1860,7 +1906,7 @@ class ContinuousBatcher:
                 jnp.ones((1,), jnp.int32), jnp.zeros((1,), jnp.float32),
                 self._base_key, jnp.int32(0))
             outs.append(tok)
-        blk, scratch = block(scratch)
+        blk, scratch, stok, spos = block(scratch, stok, spos, stmp)
         outs.append(blk)
         if self.paged and self.fused_ticks > 1:
             # fused executables (zero budget/cap: every lane frozen —
@@ -1870,20 +1916,60 @@ class ContinuousBatcher:
             if self._fns[7] is not None:
                 out = self._fns[7](
                     self.params, self._draft_params, scratch, zpt, zb,
-                    zb, self.tokens, self.pos,
-                    jnp.asarray(self.active), zb, zb,
+                    zb, stok, spos, jnp.asarray(self.active), zb, zb,
                     jnp.asarray(self._gcap))
                 outs.append(out[0])
                 scratch = out[6]
+                stok, spos = out[4], out[5]
             if self._fns[6] is not None:
                 out = self._fns[6](
-                    self.params, scratch, zpt, zb, zb, self.tokens,
-                    self.pos, jnp.asarray(self.active), self.temps,
-                    zb, zb, self._base_key, jnp.int32(0))
+                    self.params, scratch, zpt, zb, zb, stok, spos,
+                    jnp.asarray(self.active), stmp, zb, zb,
+                    self._base_key, jnp.int32(0))
                 outs.append(out[0])
                 scratch = out[3]
+                stok, spos = out[1], out[2]
         for o in outs:   # block until every compile finished
             np.asarray(o)
+
+    # -- donated-handle hygiene + HBM accounting ------------------------
+
+    def _state_handles(self) -> list:
+        """Every device handle the donating executables may consume:
+        the page pool / dense cache leaves plus the four slot
+        mirrors.  Int8 pools contribute their scale leaves here like
+        any other — values and scales alias (and are accounted)
+        together."""
+        hs = list(jax.tree.leaves(self.pool if self.paged
+                                  else self.cache))
+        hs += [self.first_toks, self.tokens, self.pos, self.temps]
+        return hs
+
+    def _pre_dispatch(self) -> list:
+        """Snapshot the donated-state handles ahead of a dispatch."""
+        return self._state_handles()
+
+    def _post_dispatch(self, old: list) -> None:
+        """Enforce the donation contract after a rebind and account
+        live bytes.  ``live`` counts the rebound state plus any OLD
+        handle not yet released — with donation on, jit deletes the
+        donated inputs at dispatch, so live is ~1× the pool; with it
+        off, input and output coexist (~2×; the bench row's A/B).
+        The debug-guard half: any stale donated handle a backend
+        left undeleted is deleted HERE, so code that squirreled away
+        a pre-dispatch reference fails loudly on its next read
+        (``RuntimeError: Array has been deleted``) instead of
+        silently pinning pool-sized garbage in HBM."""
+        new = self._state_handles()
+        new_ids = {id(h) for h in new}
+        live = sum(h.nbytes for h in new)
+        stale = [h for h in old
+                 if id(h) not in new_ids and not h.is_deleted()]
+        live += sum(h.nbytes for h in stale)
+        self.hbm.sample(live)
+        if self._donate:
+            for h in stale:
+                h.delete()
 
     # -- submission -----------------------------------------------------
 
@@ -2250,18 +2336,22 @@ class ContinuousBatcher:
                         need, bucket, self.page_size)
                     self._mark_tables_dirty(slot)
                     page_dst[i] = pages[:n_prompt_pages]
+                held = self._pre_dispatch()
                 (self.pool, self.first_toks, self.tokens,
                  self.pos, self.temps) = adopt_wave(
                     self.pool, cache_w, jnp.asarray(page_dst),
                     jnp.asarray(slots, jnp.int32), firsts, true_lens,
                     temps_w, self.first_toks, self.tokens, self.pos,
                     self.temps, k)
+                self._post_dispatch(held)
             else:
+                held = self._pre_dispatch()
                 (self.cache, self.first_toks, self.tokens,
                  self.pos, self.temps) = adopt_wave(
                     self.cache, cache_w, jnp.asarray(slots, jnp.int32),
                     firsts, true_lens, temps_w, self.first_toks,
                     self.tokens, self.pos, self.temps, k)
+                self._post_dispatch(held)
             self.wave_log.append((k, bucket))
             self._tick_work.append(("wave", k, bucket))
             self.prefill_tokens += sum(r.admit_len for r, _ in wave)
@@ -2337,11 +2427,13 @@ class ContinuousBatcher:
                                              axis=1)
             pt_row = lax.dynamic_slice_in_dim(self._pt_dev, slot, 1,
                                               axis=0)
+            held = self._pre_dispatch()
             tok, self.pool = prefill_chunk(
                 self.params, self.pool, chunk, pt_row, jnp.int32(start),
                 jnp.full((1,), t, jnp.int32),
                 jnp.full((1,), req.temperature, jnp.float32),
                 self._base_key, jnp.int32(req.rid))
+            self._post_dispatch(held)
             self.chunks_run += 1
             self._tick_work.append(("chunk", c))
             if self._tracer is not None:
@@ -2353,12 +2445,14 @@ class ContinuousBatcher:
             st["next"] = start + c
             if st["next"] >= t:
                 # final chunk (it held position t-1): go live
+                held = self._pre_dispatch()
                 (self.first_toks, self.tokens, self.pos,
                  self.temps) = activate_slot(
                     self.first_toks, self.tokens, self.pos, self.temps,
                     jnp.int32(slot), tok,
                     jnp.full((1,), t, jnp.int32),
                     jnp.full((1,), req.temperature, jnp.float32))
+                self._post_dispatch(held)
                 del self._prefilling[slot]
                 self._register_prefix(req, self._slot_pages[slot])
                 remaining = req.remaining_new
@@ -2690,6 +2784,7 @@ class ContinuousBatcher:
             budget[slot] = max(want, 0)
         self._fused_budget = budget
         budget_dev = jnp.asarray(budget)
+        held = self._pre_dispatch()
         if self.spec_gamma and not self.spec_degraded:
             (emit, take, matched, badv, self.tokens, self.pos,
              self.pool, stall) = self._fns[7](
@@ -2716,6 +2811,7 @@ class ContinuousBatcher:
             self._inflight = jnp.concatenate(
                 [blocks.reshape(-1), bads.reshape(-1), stall,
                  self.first_toks])
+        self._post_dispatch(held)
         self._inflight_k = k
         self.fused_dispatches += 1
         self.fused_ticks_run += k
@@ -2738,6 +2834,7 @@ class ContinuousBatcher:
         if k > 1:
             self._dispatch_fused(k)
             return
+        held = self._pre_dispatch()
         if self.paged and self.spec_gamma and not self.spec_degraded:
             (emit, take, matched, badv, self.tokens, self.pos,
              self.pool) = self._fns[5](
@@ -2771,6 +2868,7 @@ class ContinuousBatcher:
             self._inflight_kind = "block"
             self._inflight = jnp.concatenate(
                 [block.reshape(-1), bad, self.first_toks])
+        self._post_dispatch(held)
         self._inflight_k = 1
         self._tick += 1
 
@@ -3331,6 +3429,20 @@ class ContinuousBatcher:
         ticks_slots = self.spec_drafts_proposed / self.spec_gamma
         return 1.0 + self.spec_drafts_accepted / ticks_slots
 
+    @property
+    def hbm_pool_bytes(self) -> int:
+        """Live pool/mirror bytes at the most recent dispatch boundary
+        (``serve_hbm_pool_bytes``): ~1× the pool with donation on, ~2×
+        with it off — the cb_hbm_donation bench's A/B numerator."""
+        return self.hbm.live
+
+    @property
+    def hbm_peak_bytes(self) -> int:
+        """Peak of :attr:`hbm_pool_bytes` over the engine's lifetime
+        (``serve_hbm_peak_bytes``) — what capacity planning must
+        budget for."""
+        return self.hbm.peak
+
 
 @dataclass
 class _PoolEntry:
@@ -3716,3 +3828,16 @@ class DataParallelServePool:
         prop = sum(e.spec_drafts_proposed for e in self.replicas)
         acc = sum(e.spec_drafts_accepted for e in self.replicas)
         return 1.0 + acc / (prop / gamma) if prop else 0.0
+
+    # HBM accounting aggregates (the donation layer's pool surface):
+    # live bytes SUM across replicas (each holds its own pool), peak
+    # likewise — a failover snapshot replays from host-side prompts,
+    # so dead replicas' pools drop out of the sum with the replica
+    @property
+    def hbm_pool_bytes(self) -> int:
+        return sum(e.hbm_pool_bytes for e in self.replicas
+                   if e.dead is None)
+
+    @property
+    def hbm_peak_bytes(self) -> int:
+        return sum(e.hbm_peak_bytes for e in self.replicas)

@@ -86,6 +86,16 @@ name                            kind       meaning
                                            OUTSIDE the device sync —
                                            the cost fused ticks
                                            amortize (ISSUE 8)
+``serve_hbm_pool_bytes``        gauge      live pool + slot-mirror
+                                           bytes at the last dispatch
+                                           boundary (~1× the pool with
+                                           buffer donation on, ~2×
+                                           with it off; ISSUE 10)
+``serve_hbm_peak_bytes``        gauge      lifetime peak of the live
+                                           pool bytes — the number
+                                           capacity planning budgets
+                                           ``max_pages``/``n_slots``
+                                           against (ISSUE 10)
 ==============================  =========  ============================
 
 Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as
@@ -289,6 +299,35 @@ class MetricsRegistry:
             lines.append(f"{m}_count {n}")
             lines.append(f"{m}_sum {total}")
         return "\n".join(lines) + "\n"
+
+
+class LiveBytesTracker:
+    """Live-array byte accounting for the serving engine (ISSUE 10).
+
+    The engine calls :meth:`sample` at every dispatch boundary with the
+    bytes of its still-referenced device state (pool/cache leaves plus
+    the slot mirrors, plus any stale pre-dispatch handles the backend
+    has not yet deleted).  With buffer donation on, XLA writes each
+    tick's outputs into the inputs' buffers and deletes the inputs, so
+    the sample sits at ~1× the pool; without donation the old handles
+    stay live until the host drops them — ~2×.  The on/off ratio is
+    exactly what the ``cb_hbm_donation`` bench row asserts, and the two
+    gauges below are what capacity planning budgets ``max_pages`` /
+    ``n_slots`` against."""
+
+    def __init__(self, registry: MetricsRegistry | None = None) -> None:
+        self.registry = registry
+        self.live = 0
+        self.peak = 0
+        self.samples = 0
+
+    def sample(self, live_bytes: int) -> None:
+        self.live = int(live_bytes)
+        self.peak = max(self.peak, self.live)
+        self.samples += 1
+        if self.registry is not None:
+            self.registry.set_gauge("serve_hbm_pool_bytes", self.live)
+            self.registry.set_gauge("serve_hbm_peak_bytes", self.peak)
 
 
 def parse_prometheus(text: str) -> dict[str, dict]:

@@ -196,11 +196,16 @@ class DeviceScheduler:
         acc = out.get("serve_engine_spec_accept_rate")
         if acc is not None:
             self.metrics.set_gauge("serving_spec_acceptance", acc)
+        # HBM accounting rides the same harvest (ISSUE 10): live/peak
+        # pool bytes per pod, mirrored so capacity planning reads the
+        # engine's real donation-era footprint off the scrape surface
         for src, dst in (
                 ("serve_failover_total", "serving_failover_total"),
                 ("serve_requests_retried", "serving_requests_retried"),
                 ("serve_slots_quarantined",
-                 "serving_slots_quarantined")):
+                 "serving_slots_quarantined"),
+                ("serve_hbm_pool_bytes", "serving_hbm_pool_bytes"),
+                ("serve_hbm_peak_bytes", "serving_hbm_peak_bytes")):
             v = out.get(src)
             if v is not None:
                 self.metrics.set_gauge(dst, v)

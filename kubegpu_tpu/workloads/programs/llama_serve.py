@@ -388,7 +388,13 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                 ("serve_slots_quarantined", eng.slots_quarantined),
                 ("serve_requests_shed",
                  eng.requests_shed if hasattr(eng, "requests_shed")
-                 else sum(e.requests_shed for e in eng.replicas))):
+                 else sum(e.requests_shed for e in eng.replicas)),
+                # HBM accounting echo (ISSUE 10): live/peak pool bytes
+                # at the engine's dispatch boundaries — with buffer
+                # donation this sits at ~1× the pool; ~2× means
+                # donation silently stopped aliasing on this build
+                ("serve_hbm_pool_bytes", eng.hbm_pool_bytes),
+                ("serve_hbm_peak_bytes", eng.hbm_peak_bytes)):
             print(json.dumps({"metric": name, "value": value}))
         if tracer is not None:
             # trace echo: span count is harvestable; the full Perfetto

@@ -191,6 +191,37 @@ def test_ktp006_locked_suffix_convention(tmp_path):
     assert fs == []
 
 
+def test_ktp007_undonated_serving_executable(tmp_path):
+    # inside an engine factory, wrapping a pool-threading body with a
+    # jit-family call and NO donate= is the silent 2x-HBM regression;
+    # both wrap spellings (call and decorator) must fire, and a wrap
+    # that declares donation — even donate=() — must not
+    fs = _lint(tmp_path, """\
+        import functools
+        import jax
+        from kubegpu_tpu.parallel.sharding import donating_jit
+
+        def _paged_engine_fns(cfg, donate=True):
+            def _block_body(params, pool, tokens):
+                return tokens, pool
+
+            @functools.partial(jax.jit)       # decorator wrap, bad
+            def prefill_chunk(params, pool, chunk):
+                return pool
+
+            decode_block = jax.jit(_block_body)          # bad
+            verify_block = donating_jit(                 # fine
+                _block_body, donate=("pool",))
+            off_block = donating_jit(_block_body, donate=())  # fine
+            return decode_block, prefill_chunk, verify_block
+
+        def host_helper(pool):
+            return jax.jit(lambda p: p)(pool)   # not a factory: exempt
+        """)
+    assert _codes(fs) == ["KTP007"] and len(fs) == 2
+    assert "donat" in fs[0].message
+
+
 # ---------------------------------------------------------------------------
 # blessing channels: TOML entries and inline pins
 # ---------------------------------------------------------------------------

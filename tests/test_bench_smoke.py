@@ -152,6 +152,38 @@ def test_serving_bench_smoke_parses_and_carries_keys():
         "fused ticks must shrink per-token host overhead"
     assert ft["host_overhead_reduction_x"] > 1.0
 
+    # HBM-lean serving (ISSUE 10): the donation-on/off A/B must be
+    # bit-exact, show the steady-state live-pool bytes dropping by the
+    # acceptance floor (1.4x; the mechanism delivers ~2x — input AND
+    # output pool buffers live vs one), carry non-empty compiled
+    # input_output_aliases COVERING every donated argument of every
+    # executable on both the bf16 and int8-KV engines, and demonstrate
+    # the capacity headroom by actually running a bigger engine inside
+    # the old byte budget.
+    hb = doc["cb_hbm_donation"]
+    assert hb["bit_exact"] is True
+    assert hb["pool_bytes_ratio"] >= 1.4
+    assert hb["donation_on"]["samples"] > 0
+    assert hb["donation_on"]["peak_bytes"] > 0
+    assert hb["aliases_covered"] is True
+    for label in ("bf16", "int8"):
+        rep = hb["input_output_aliases"][label]
+        assert rep, label                        # census is non-empty
+        for name, row in rep.items():
+            assert row["aliased_params"] > 0, (label, name)
+            assert row["covered"] is True, (label, name)
+            assert row["args"], (label, name)
+    # the int8 engine's pool rows must alias all four leaves — values
+    # AND QTensor scales (a half-donated quantized pool would read
+    # "2/4" here)
+    assert hb["input_output_aliases"]["int8"]["decode_block"]["args"][
+        "pool"] == "4/4"
+    ch_ = hb["capacity_headroom"]
+    assert ch_["fits_budget"] is True
+    assert ch_["total_pages_donation"] > ch_["total_pages_no_donation"]
+    assert ch_["n_slots_donation"] > ch_["n_slots_no_donation"]
+    assert ch_["tokens"] > 0
+
     # compile-signature census (ISSUE 9): the scripted workload's
     # distinct lowering-signature set must equal the enumerated
     # expected set — zero violations — and the row must carry the

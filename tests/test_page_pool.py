@@ -569,3 +569,62 @@ class TestRefcountedPrefixPool:
         # every non-cached page back on the free list
         assert len(eng._free_pages) + len(eng._page_refs) == \
             eng.total_pages
+
+
+class TestDonatedHandleHygiene:
+    """Buffer donation (ISSUE 10): the engine's executables alias their
+    pool/mirror outputs INTO the input buffers, so a host-side handle
+    captured before a dispatch is dead after it.  These tests pin the
+    debug guard's contract — stale reads fail LOUDLY — and that the
+    fuzz suites above (which run with the donation default, ON) are
+    actually exercising aliased pools."""
+
+    def test_fuzz_default_runs_with_donation_on(self, tiny):
+        cfg, params = tiny
+        assert make_engine(cfg, params)._donate, \
+            "fuzz suites must cover the donation default"
+
+    def test_stale_pool_handle_read_raises_after_dispatch(self, tiny):
+        cfg, params = tiny
+        eng = make_engine(cfg, params)
+        eng.submit(list(range(1, 9)), 8)
+        eng.step()                   # admission: pool adopted + rebound
+        stale_pool = eng.pool["k"]
+        stale_tok = eng.tokens       # slot mirror — donated too
+        eng.step()                   # decode tick donates both
+        assert stale_pool is not eng.pool["k"]
+        assert stale_pool.is_deleted()
+        assert stale_tok.is_deleted()
+        with pytest.raises(RuntimeError):
+            np.asarray(stale_pool)
+        with pytest.raises(RuntimeError):
+            np.asarray(stale_tok)
+        # the engine's own handles stay live and the request finishes
+        done = eng.drain()
+        assert len(done) == 1 and len(done[0].tokens) == 8
+
+    def test_int8_scales_die_with_their_values(self, tiny):
+        # QTensor-aware donation: the int8 pool's scale leaves alias
+        # (and die) alongside k/v — a half-donated pool would silently
+        # keep the scale copies live
+        cfg, params = tiny
+        eng = make_engine(cfg, params, kv_int8=True)
+        eng.submit(list(range(1, 9)), 6)
+        eng.step()
+        stale = {n: eng.pool[n] for n in
+                 ("k", "v", "k_scale", "v_scale")}
+        eng.step()
+        for name, h in stale.items():
+            assert h.is_deleted(), f"{name} survived donation"
+        assert len(eng.drain()) == 1
+
+    def test_donation_off_keeps_old_handles_readable(self, tiny):
+        cfg, params = tiny
+        eng = make_engine(cfg, params, donate=False)
+        eng.submit(list(range(1, 9)), 8)
+        eng.step()
+        stale = eng.pool["k"]
+        eng.step()
+        assert not stale.is_deleted()
+        np.asarray(stale)            # must not raise
+        assert len(eng.drain()) == 1
