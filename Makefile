@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
-        fused-smoke hbm-smoke analyze clean
+        fused-smoke hbm-smoke disagg-smoke analyze clean
 
 all: native
 
@@ -68,6 +68,29 @@ hbm-smoke: analyze              # ISSUE 10 HBM-lean serving: donation
 		r = row['cb_hbm_donation']; \
 		assert r['bit_exact'] and r['aliases_covered']; \
 		assert r['pool_bytes_ratio'] >= 1.4, r['pool_bytes_ratio']"
+
+disagg-smoke: analyze           # ISSUE 11 disaggregated serving: page-
+	# chain export/import property tests (bit-exact pages + refcounts,
+	# bf16 AND int8, donation on, chaos mid-migration kill), then the
+	# equal-chip role-split A/B — bit-exact tokens, every request
+	# migrated, TTFT p99 AND decode-stall p99 both below symmetric dp
+	# (asserted on the DETERMINISTIC tick/work twins; the ms tails are
+	# printed but read as weather on a loaded CPU host).
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_page_pool.py -q -k "ChainMigration"
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -c "import json; \
+		from kubegpu_tpu.benchmark import run_serving_bench_smoke; \
+		row = run_serving_bench_smoke(legs=['cb_disagg']); \
+		print(json.dumps(row, indent=1)); \
+		r = row['cb_disagg']; \
+		assert r['bit_exact'], 'tokens diverged'; \
+		assert r['disagg']['migrations'] >= 1, 'nothing migrated'; \
+		assert r['ttft_ticks_reduction_x'] > 1.0, r; \
+		assert r['queue_wait_ticks_reduction_x'] > 1.0, r; \
+		assert r['symmetric']['decode_stall_work_p99'] > 0.0, r; \
+		assert r['disagg']['decode_stall_work_p99'] == 0.0, r"
 
 trace-smoke:                    # ISSUE 6 observability: a traced serve
 	# window must yield ONE connected span tree from extender bind

@@ -46,7 +46,8 @@ from .report import Finding
 # _fns tuple order, fixed by _paged_engine_fns / _engine_fns.
 EXECUTABLES = ("decode_block", "prefill_wave", "adopt_wave",
                "prefill_chunk", "activate_slot", "verify_block",
-               "decode_fused", "verify_fused")
+               "decode_fused", "verify_fused",
+               "export_chain", "import_chain")
 
 # dtypes whose widening to f32 the census must account for
 _NARROW = ("bfloat16", "float16", "int8")
@@ -214,6 +215,14 @@ def representative_args(eng) -> dict:
         "decode_fused": ((eng.params, eng.pool, zpt, zb, zb, zb, zb,
                           act, zf, zb, zb, key, jnp.int32(0)), None),
     }
+    # migration executables (ISSUE 11): page-id vectors are ALWAYS
+    # int32[max_pages]; the chain mirrors the pool's leaf structure
+    # with max_pages rows on the page axis
+    zids = jnp.zeros((eng.max_pages,), jnp.int32)
+    chain = {name: jnp.take(leaf, zids, axis=1)
+             for name, leaf in eng.pool.items()}
+    sets["export_chain"] = ((eng.pool, zids), None)
+    sets["import_chain"] = ((eng.pool, chain, zids), None)
     if eng._fns[5] is not None:
         import jax.numpy as jnp
         gcap = jnp.asarray(eng._gcap)
@@ -355,6 +364,24 @@ def _drive_plain(eng) -> None:
         eng.step()
         if poisoned and not eng.slot_req and not eng.queue:
             break
+    # migration phase (ISSUE 11): a migrate-out prefill leg (chunk
+    # path — the prompt exceeds the chunk) retires after its first
+    # token and exports its page chain; the chain re-imports into the
+    # same engine and decodes out — each migration executable
+    # dispatches at its one fixed shape
+    mrid = eng.submit(list(range(2, 13)), max_new_tokens=1,
+                      migrate_out=True)
+    for _ in range(30):
+        eng.step()
+        if not eng.slot_req and not eng.queue:
+            break
+    exp = eng.take_export(mrid)
+    if exp is not None:
+        eng.import_chain(exp, max_new_tokens=6)
+        for _ in range(40):
+            eng.step()
+            if not eng.slot_req and not eng.queue:
+                break
 
 
 def _drive_spec(eng) -> None:
@@ -390,6 +417,11 @@ def run_census_workloads():
         problems.append(
             f"plain workload did not drain ({len(eng.slot_req)} slots "
             f"busy, {len(eng.queue)} queued)")
+    if eng.chains_exported < 1 or eng.chains_imported < 1:
+        problems.append(
+            "plain workload: the migration phase never fired "
+            f"(exported={eng.chains_exported}, "
+            f"imported={eng.chains_imported})")
     eng_s = build_audit_engine(spec=True)
     shims["spec"] = _CensusShim(eng_s)
     _drive_spec(eng_s)
@@ -446,12 +478,19 @@ def expected_signatures() -> dict[str, frozenset]:
     vfused = (f"verify_fused({pt},{zb},{zb},{zb},{zb},{act},{zb},"
               f"{zb},{zb})")
 
+    # migration executables (ISSUE 11): page-id vectors are pinned to
+    # int32[max_pages] regardless of chain length — ONE signature per
+    # direction, ever
+    export = f"export_chain(int32[{PT}])"
+    imprt = f"import_chain(int32[{PT}])"
+
     plain = {
         wave(2), adopt(2),   # phase 1+3: paired same-bucket admission
         fused,               # steady-state fused K=4 decode
         chunk, activate,     # phase 2: chunked prefill (len 12 > chunk)
                              # — ALSO the quarantine replay's path
         decode,              # K=1 decode while a chunk is in flight
+        export, imprt,       # phase 4: page-chain migration round-trip
     }
     spec = {
         wave(2), adopt(2),   # paired admission

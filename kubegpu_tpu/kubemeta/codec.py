@@ -28,6 +28,11 @@ MESH_AXES_KEY = "pod.alpha.kubetpu/mesh-axes"
 # never talk — so the scheduler scores their slices with serving axis
 # weights instead of the training defaults
 WORKLOAD_KIND_KEY = "pod.alpha.kubetpu/workload-kind"
+# serving role ("prefill" | "decode") on a DISAGGREGATED serving gang:
+# prefill replicas are throughput-bound batch engines off the token
+# feedback path, decode replicas are latency-bound — placement scores
+# their slices with role-adjusted serving weights
+SERVE_ROLE_KEY = "pod.alpha.kubetpu/serve-role"
 MULTISLICE_KEY = "pod.alpha.kubetpu/multislice"
 MIGRATABLE_KEY = "pod.alpha.kubetpu/migratable"
 # original queue position of an evicted+requeued pod: eviction (fault,
@@ -224,6 +229,22 @@ def set_pod_workload_kind(pod: Pod, kind: str) -> None:
 
 def pod_workload_kind(pod: Pod) -> str:
     return pod.metadata.annotations.get(WORKLOAD_KIND_KEY, "training")
+
+
+def set_pod_serve_role(pod: Pod, role: str) -> None:
+    """Annotate a serving pod with its disaggregated role: "prefill"
+    replicas run chunked prefill and export KV page chains, "decode"
+    replicas adopt them and stream tokens.  Placement reads the role
+    through :func:`pod_serve_role` to pick role-aware axis weights."""
+    if role not in ("prefill", "decode"):
+        raise ValueError(f"unknown serve role {role!r}")
+    pod.metadata.annotations[SERVE_ROLE_KEY] = role
+
+
+def pod_serve_role(pod: Pod) -> str | None:
+    """The pod's disaggregated serving role, or None on a symmetric
+    (or non-serving) pod."""
+    return pod.metadata.annotations.get(SERVE_ROLE_KEY)
 
 
 def set_pod_migratable(pod: Pod, allowed: bool = True) -> None:

@@ -95,6 +95,7 @@ on).
 from __future__ import annotations
 
 import functools
+import hashlib
 import time
 from collections import deque
 from dataclasses import dataclass, field, replace
@@ -142,6 +143,12 @@ PAGED_DONATED = {
     "verify_block": ("pool", "tokens", "pos"),
     "decode_fused": ("pool", "tokens", "pos"),
     "verify_fused": ("pool", "tokens", "pos"),
+    # migration: export keeps the source pool live (the exporting
+    # engine serves on); import donates ONLY the pool — the uploaded
+    # chain leaves are shaped [L, max_pages, ...], not pool-shaped,
+    # so they can never alias a pool output
+    "export_chain": (),
+    "import_chain": ("pool",),
 }
 
 DENSE_DONATED = {
@@ -897,6 +904,28 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         temps = lax.dynamic_update_slice(temps, temp, (slot,))
         return first_toks, tokens, pos, temps
 
+    # -- page migration (disaggregated serving): export gathers one  --
+    # -- request's page chain out of the pool, import scatters it    --
+    # -- into another engine's pool.  page_ids is ALWAYS a fixed     --
+    # -- int32[max_pages] vector (padded with trash-page zeros) so   --
+    # -- each direction lowers to exactly ONE census signature.      --
+
+    def _export_body(pool, page_ids):
+        """Gather a page chain for migration.  The chain carries every
+        pool leaf — int8 values AND their QTensor scales — so the
+        importing engine resumes from bit-identical pool bytes.  Pool
+        is NOT donated: the exporting engine keeps serving from it."""
+        from kubegpu_tpu.ops.paged_attention import gather_pages
+        return gather_pages(pool, page_ids)
+
+    def _import_body(pool, chain, page_dst):
+        """Scatter a migrated chain into freshly allocated pages.  The
+        pool is donated (the engine rebinds it); the chain leaves are
+        NOT — they are differently shaped host uploads and cannot
+        alias pool outputs."""
+        from kubegpu_tpu.ops.paged_attention import scatter_pages
+        return scatter_pages(pool, chain, page_dst)
+
     # -- speculative tick (spec_gamma > 0): batched early-exit self- --
     # -- draft + ONE full-model verify over [n_slots, γ+1] positions --
     _spec_body = None
@@ -1207,8 +1236,13 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         verify_fused = (donating_jit(_fused_spec_body,
                                      donate=don("verify_fused"))
                         if _fused_spec_body is not None else None)
+        export_chain = donating_jit(_export_body,
+                                    donate=don("export_chain"))
+        import_chain = donating_jit(_import_body,
+                                    donate=don("import_chain"))
         return decode_block, prefill_wave, adopt_wave, prefill_chunk, \
-            activate_slot, verify_block, decode_fused, verify_fused
+            activate_slot, verify_block, decode_fused, verify_fused, \
+            export_chain, import_chain
 
     # -- mesh-native wrapping (shard_map over the tp axis) --------------
     # donating_jit composes the shard_map (replication checking off:
@@ -1269,8 +1303,20 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             in_specs=(pspec, pspec, pool_spec) + (rep,) * 9,
             out_specs=(rep,) * 6 + (pool_spec, rep))
 
+    # migration executables: the chain gathers/scatters per-chip head
+    # shards exactly like the pool it came from, so a chain leaf
+    # inherits the pool's spec
+    export_chain = donating_jit(
+        _export_body, donate=don("export_chain"), mesh=mesh,
+        in_specs=(pool_spec, rep), out_specs=pool_spec)
+
+    import_chain = donating_jit(
+        _import_body, donate=don("import_chain"), mesh=mesh,
+        in_specs=(pool_spec, pool_spec, rep), out_specs=pool_spec)
+
     return decode_block, prefill_wave, adopt_wave, prefill_chunk, \
-        activate_slot, verify_block, decode_fused, verify_fused
+        activate_slot, verify_block, decode_fused, verify_fused, \
+        export_chain, import_chain
 
 
 # ---------------------------------------------------------------------------
@@ -1289,6 +1335,21 @@ def _trim_acct(xs: list) -> None:
     smoke runs never reach the cap, so their numbers are unchanged."""
     if len(xs) > _ACCT_CAP:
         del xs[:len(xs) - _ACCT_CAP // 2]
+
+
+def _chain_digest(chain: dict, t: int) -> str:
+    """Content hash of an exported page chain (every leaf — int8
+    values AND scales — plus the prompt length).  The importing engine
+    recomputes and compares before touching its pool, so a corrupted
+    or torn transfer fails loudly instead of decoding garbage."""
+    h = hashlib.sha256(str(t).encode())
+    for name in sorted(chain):
+        a = np.ascontiguousarray(chain[name])
+        h.update(name.encode())
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 @dataclass
@@ -1791,6 +1852,19 @@ class ContinuousBatcher:
         # request is never replayed (exactly-once)
         self._orphans: list[_Request] = []
         self._inflight_spec = False       # layout of the in-flight fetch
+        # -- page migration (disaggregated serving) -------------------
+        # ``_migrate_out``: rids whose page chain must be exported at
+        # retirement (the prefill-specialist contract); ``_exports``:
+        # finished exports keyed by rid, held host-side until the pool
+        # pops them with take_export() — host numpy, so they survive
+        # this replica's death and a mid-migration kill replays
+        # exactly-once from the stash.
+        self._migrate_out: set[int] = set()
+        self._exports: dict[int, dict] = {}
+        self.chains_exported = 0
+        self.chains_imported = 0
+        self.pages_migrated_out = 0
+        self.pages_migrated_in = 0
         # -- fused-block accounting (ISSUE 8) -------------------------
         # ``_inflight_kind``/``_inflight_k`` pin the LAYOUT of the
         # in-flight fetch ("block" | "spec" | "fused" | "fused_spec")
@@ -1827,6 +1901,7 @@ class ContinuousBatcher:
                 self._engine_anchor = sp.context
         self._req_spans: dict[int, object] = {}   # rid → open Span
         self._submit_ts: dict[int, float] = {}    # rid → submit wall
+        self._submit_tick: dict[int, int] = {}    # rid → submit tick
         self._first_tok_ts: dict[int, float] = {}  # rid → TTFT wall
 
     def warmup(self) -> None:
@@ -1906,6 +1981,14 @@ class ContinuousBatcher:
                 jnp.ones((1,), jnp.int32), jnp.zeros((1,), jnp.float32),
                 self._base_key, jnp.int32(0))
             outs.append(tok)
+        if self.paged:
+            # migration executables (gather a zero chain out of the
+            # scratch pool and scatter it straight back — trash-page
+            # indices only, so the scratch stays all-zero)
+            zids = jnp.zeros((self.max_pages,), jnp.int32)
+            chain = self._fns[8](scratch, zids)
+            scratch = self._fns[9](scratch, chain, zids)
+            outs.append(chain["k"])
         blk, scratch, stok, spos = block(scratch, stok, spos, stmp)
         outs.append(blk)
         if self.paged and self.fused_ticks > 1:
@@ -1975,15 +2058,24 @@ class ContinuousBatcher:
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
-               deadline_s: float | None = None) -> int:
+               deadline_s: float | None = None,
+               migrate_out: bool = False) -> int:
         """Enqueue a request.  ``prompt``: 1-D int sequence;
         ``temperature`` 0 decodes greedily, > 0 samples;
         ``deadline_s`` (optional) cancels the request if it has not
         completed that many seconds from now (it returns FAILED with
-        ``error='deadline exceeded'`` — partial tokens preserved)."""
+        ``error='deadline exceeded'`` — partial tokens preserved).
+        ``migrate_out`` marks the request for page-chain export at
+        retirement (the prefill-specialist leg of disaggregated
+        serving): its pool pages are gathered host-side just before
+        release and published via :meth:`take_export`."""
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if migrate_out and not self.paged:
+            raise ValueError(
+                "migrate_out needs the paged pool (page chains are "
+                "the migration transfer unit)")
         if temperature < 0:
             raise ValueError(
                 f"temperature must be >= 0, got {temperature}")
@@ -2040,9 +2132,12 @@ class ContinuousBatcher:
                        deadline=(time.monotonic() + deadline_s
                                  if deadline_s is not None else None))
         self._next_rid += 1
+        if migrate_out:
+            self._migrate_out.add(req.rid)
         self.queue.append((req, padded))
         if self._tracer is not None or self._metrics is not None:
             self._submit_ts[req.rid] = time.perf_counter()
+            self._submit_tick[req.rid] = self._tick
         if self._tracer is not None:
             sp = self._tracer.start_span(
                 "request", parent=self._engine_anchor,
@@ -2161,6 +2256,14 @@ class ContinuousBatcher:
         wait_ms = (now - t_sub) * 1e3 if t_sub is not None else None
         if wait_ms is not None and self._metrics is not None:
             self._metrics.observe("serve_queue_wait_ms", wait_ms)
+        # tick-denominated twin: engine service rounds spent queued.
+        # Wall clocks are weather on a loaded host; the tick count is
+        # a pure function of the admission schedule, so CPU smoke
+        # benches gate on THIS and leave the ms tails to hardware.
+        k_sub = self._submit_tick.get(req.rid)
+        if k_sub is not None and self._metrics is not None:
+            self._metrics.observe("serve_queue_wait_ticks",
+                                  float(self._tick - k_sub))
         if self._tracer is None:
             return
         sp = self._req_spans.get(req.rid)
@@ -2182,6 +2285,10 @@ class ContinuousBatcher:
         ttft = (now - t_sub) * 1e3
         if self._metrics is not None:
             self._metrics.observe("serve_ttft_ms", ttft)
+            k_sub = self._submit_tick.get(req.rid)
+            if k_sub is not None:
+                self._metrics.observe("serve_ttft_ticks",
+                                      float(self._tick - k_sub))
         sp = self._req_spans.get(req.rid)
         if sp is not None:
             sp.set_attr("ttft_ms", round(ttft, 3))
@@ -2192,6 +2299,7 @@ class ContinuousBatcher:
         request reaches a terminal state (retire/shed/cancel/fail)."""
         t_first = self._first_tok_ts.pop(req.rid, None)
         self._submit_ts.pop(req.rid, None)
+        self._submit_tick.pop(req.rid, None)
         sp = self._req_spans.pop(req.rid, None)
         if sp is None and (self._metrics is None or t_first is None):
             return
@@ -2970,9 +3078,27 @@ class ContinuousBatcher:
                 self.stall_ms.append(stall)
                 self._tick_log.append({"tick": self._tick - 1,
                                        "work": self._tick_work})
-                if self._metrics is not None:
+                # the histogram is a DECODE-stall: only ticks where a
+                # decode-phase slot actually waited behind the admission
+                # + chunk work count (a pure-prefill tick stalls nobody,
+                # and on a role-split prefill replica every tick is
+                # one).  A max_new_tokens == 1 request HAS no decode
+                # phase — after its prefill chunk computes the single
+                # token the slot only awaits readout, so it cannot be
+                # stalled by chunk work either.
+                if self._metrics is not None and any(
+                        s not in self._prefilling
+                        and self.slot_req[s].max_new_tokens > 1
+                        for s in self.slot_req):
                     self._metrics.observe("serve_decode_stall_ms",
                                           stall)
+                    # structural twin: HOW MANY admission/chunk work
+                    # units the decode-phase slots waited behind this
+                    # tick — 0 on a tick that interleaved nothing.
+                    # Deterministic (pure schedule), so the CPU smoke
+                    # A/B gates on this where the ms tail is weather.
+                    self._metrics.observe("serve_decode_stall_work",
+                                          float(len(self._tick_work)))
                 if self._tracer is not None:
                     self._trace_tick(t_tick, t_col, t_adm, stall,
                                      t_d0, len(finished))
@@ -3043,6 +3169,12 @@ class ContinuousBatcher:
 
     def _retire(self, slot: int, req: _Request,
                 finished: list[_Request]) -> None:
+        if (req.rid in self._migrate_out and req.error is None
+                and req.tokens):
+            # export BEFORE the pages go back to the free list — the
+            # gather must see this request's bytes, not a reuse
+            self._export_chain_slot(slot, req)
+        self._migrate_out.discard(req.rid)
         req.done = True
         finished.append(req)
         self._finish_request_trace(req)
@@ -3054,6 +3186,141 @@ class ContinuousBatcher:
             # own rolling acceptance says otherwise
             self._accept_ema[slot] = 1.0
             self._gcap[slot] = self.spec_gamma
+
+    # -- page-chain migration (disaggregated serving) -------------------
+
+    def _export_chain_slot(self, slot: int, req: _Request) -> None:
+        """Gather the retiring request's page chain host-side and
+        stash it for :meth:`take_export`.  The chain covers the FULL
+        page-aligned prompt region ``[0, tpad)`` — under the prefill
+        contract (``max_new_tokens == 1``) nothing has flushed past it
+        — so the importer resumes from bit-identical pool bytes.  The
+        export is plain numpy: it survives this replica's death, which
+        is what makes a mid-migration kill replay exactly-once."""
+        n_chain = int(self._tpad[slot]) // self.page_size
+        page_ids = np.zeros((self.max_pages,), np.int32)
+        page_ids[:n_chain] = self._pt[slot, :n_chain]
+        chain_dev = self._fns[8](self.pool, jnp.asarray(page_ids))
+        chain = {name: np.ascontiguousarray(np.asarray(leaf)[:, :n_chain])
+                 for name, leaf in chain_dev.items()}
+        t = int(self._tvec[slot])
+        self._exports[req.rid] = {
+            "rid": req.rid, "t": t, "tpad": int(self._tpad[slot]),
+            "pages": n_chain, "page_size": self.page_size,
+            "prefix_keys": tuple(req.prefix_keys),
+            "first_token": int(req.tokens[0]), "prompt": req.prompt,
+            "chain": chain, "digest": _chain_digest(chain, t),
+        }
+        self.chains_exported += 1
+        self.pages_migrated_out += n_chain
+
+    def take_export(self, rid: int) -> dict | None:
+        """Pop one finished export — exactly-once (a second call
+        returns None).  Callable on a DEAD replica: the stash is
+        host-side state, not device state."""
+        return self._exports.pop(rid, None)
+
+    def take_exports(self) -> dict[int, dict]:
+        """Pop every finished export at once (census/test driver)."""
+        out, self._exports = self._exports, {}
+        return out
+
+    def import_chain(self, export: dict, max_new_tokens: int,
+                     temperature: float = 0.0) -> int | None:
+        """Adopt a migrated page chain: verify the digest, allocate
+        pages, scatter the chain in, activate a slot mid-decode (the
+        first generated token travels inside the export), and register
+        the prompt pages in the prefix registry so later shared-prefix
+        requests alias them for free.  Returns the LOCAL rid, or
+        ``None`` when no slot/pages are free right now (the caller
+        retries a later tick).  ``max_new_tokens`` is the TOTAL budget
+        for this leg including the already-produced first token."""
+        from kubegpu_tpu.ops.paged_attention import decode_capacity
+        if not self.paged:
+            raise ValueError("import_chain needs the paged pool")
+        if self.dead is not None:
+            raise ReplicaDeadError(f"replica dead: {self.dead}")
+        if max_new_tokens < 2:
+            raise ValueError(
+                "import_chain needs max_new_tokens >= 2 — a satisfied "
+                "request retires at its prefill replica")
+        if temperature > 0 and not self.sampling:
+            raise ValueError(
+                "temperature > 0 needs a sampling-enabled engine")
+        if int(export["page_size"]) != self.page_size:
+            raise ValueError(
+                f"page-size mismatch: chain {export['page_size']} vs "
+                f"pool {self.page_size}")
+        chain = export["chain"]
+        t = int(export["t"])
+        if _chain_digest(chain, t) != export["digest"]:
+            raise ValueError(
+                "chain digest mismatch — torn or corrupted transfer")
+        bucket = int(export["tpad"])
+        n_chain = int(export["pages"])
+        overhang = max(self.stride, self.spec_gamma + 1
+                       if self.spec_gamma else 0)
+        if t + max_new_tokens + overhang > self.max_len:
+            raise ValueError(
+                f"prompt {t} + max_new {max_new_tokens} + overhang "
+                f"{overhang} > max_len {self.max_len}")
+        need = self._pages_needed(max_new_tokens, bucket)
+        if need > self.total_pages:
+            raise ValueError(
+                f"import needs {need} pages but the pool has only "
+                f"{self.total_pages}")
+        slot = next((s for s in range(self.n_slots)
+                     if s not in self.slot_req), None)
+        if slot is None or self._available_pages() < need:
+            return None
+        req = _Request(rid=self._next_rid, prompt_len=t,
+                       max_new_tokens=max_new_tokens,
+                       temperature=float(temperature),
+                       prefix_keys=tuple(export["prefix_keys"]),
+                       prompt=np.asarray(export["prompt"], np.int32),
+                       admit_len=t)
+        self._next_rid += 1
+        req.tokens = [int(export["first_token"])]
+        pages = self._alloc_pages(need)
+        self._slot_pages[slot] = pages
+        self._pt[slot, :] = 0
+        self._pt[slot, :need] = pages
+        self._tvec[slot] = t
+        self._tpad[slot] = bucket
+        self._cap[slot] = decode_capacity(need, bucket, self.page_size)
+        self._mark_tables_dirty(slot)
+        # pad the host chain back to the fixed [*, max_pages, ...]
+        # upload shape — page_ids is always int32[max_pages], so each
+        # migration direction lowers to exactly ONE census signature
+        page_dst = np.zeros((self.max_pages,), np.int32)
+        page_dst[:n_chain] = pages[:n_chain]
+        chain_up = {}
+        for name, a in chain.items():
+            pad = [(0, 0)] * a.ndim
+            pad[1] = (0, self.max_pages - a.shape[1])
+            chain_up[name] = jnp.asarray(np.pad(a, pad))
+        held = self._pre_dispatch()
+        self.pool = self._fns[9](self.pool, chain_up,
+                                 jnp.asarray(page_dst))
+        (self.first_toks, self.tokens, self.pos,
+         self.temps) = self._fns[4](
+            self.first_toks, self.tokens, self.pos, self.temps,
+            jnp.int32(slot),
+            jnp.full((1,), req.tokens[0], jnp.int32),
+            jnp.full((1,), t, jnp.int32),
+            jnp.full((1,), req.temperature, jnp.float32))
+        self._post_dispatch(held)
+        self.slot_req[slot] = req
+        self._register_prefix(req, pages)
+        self._set_active(slot, True)
+        # the first token was consumed (and TTFT stamped) at the
+        # prefill replica — the slot is NOT awaiting a first token
+        if self.spec_gamma:
+            self._accept_ema[slot] = 1.0
+            self._gcap[slot] = self.spec_gamma
+        self.chains_imported += 1
+        self.pages_migrated_in += n_chain
+        return req.rid
 
     def _consume(self, fused: np.ndarray,
                  spec_active: np.ndarray | None,
@@ -3542,6 +3809,15 @@ class DataParallelServePool:
     def _load(self, eng: ContinuousBatcher) -> int:
         return len(eng.queue) + len(eng.slot_req)
 
+    def _route_key(self, j: int):
+        """Least-loaded routing key: request count, then QUEUED PROMPT
+        TOKENS as the tiebreak (two replicas with equal request counts
+        can hide very different prefill backlogs), then the index for
+        determinism."""
+        eng = self.replicas[j]
+        return (self._load(eng),
+                sum(r.prompt_len for r, _ in eng.queue), j)
+
     def _alive(self) -> list[int]:
         return [i for i in range(self.dp) if i not in self.dead_replicas]
 
@@ -3554,7 +3830,7 @@ class DataParallelServePool:
                 "no healthy replicas left: "
                 + "; ".join(f"replica {i}: {r}"
                             for i, r in self.dead_replicas.items()))
-        i = min(alive, key=lambda j: self._load(self.replicas[j]))
+        i = min(alive, key=self._route_key)
         local = self.replicas[i].submit(prompt, max_new_tokens,
                                         temperature)
         rid = self._next_rid
@@ -3628,6 +3904,16 @@ class DataParallelServePool:
         r.rid = rid
         done.append(r)
 
+    def _replay_submit(self, replay, remaining: int,
+                       e: "_PoolEntry") -> tuple[int, int]:
+        """Place one replay (prompt + accepted prefix, remaining
+        budget) on a healthy replica; returns ``(replica, local_rid)``
+        and lets the engine's ValueError propagate.  The routing hook
+        the disaggregated pool overrides with role awareness."""
+        j = min(self._alive(), key=self._route_key)
+        return j, self.replicas[j].submit(replay, remaining,
+                                          e.temperature)
+
     def _failover(self, i: int, reason: str, done: list) -> None:
         """Re-admit every request resident on dead replica ``i`` onto
         healthy replicas via bit-exact greedy replay (prompt +
@@ -3682,10 +3968,8 @@ class DataParallelServePool:
             replay = (np.concatenate(
                 [e.prompt, np.asarray(e.prefix, np.int32)])
                 if e.prefix else e.prompt)
-            j = min(alive, key=lambda k: self._load(self.replicas[k]))
             try:
-                new_local = self.replicas[j].submit(
-                    replay, remaining, e.temperature)
+                j, new_local = self._replay_submit(replay, remaining, e)
             except ValueError as err:
                 self._fail_entry(e, f"replay rejected: {err}", done)
                 continue
@@ -3757,6 +4041,13 @@ class DataParallelServePool:
                 continue
             for r in rs:
                 self._finish(i, r, done)
+        if self._metrics is not None:
+            # per-replica queue depth (the router's own signal,
+            # exported): one gauge per replica index
+            for i, eng in enumerate(self.replicas):
+                self._metrics.set_gauge(
+                    "serve_replica_queue_depth" + f"_r{i}",
+                    float(len(eng.queue)))
         return done
 
     def drain(self, max_ticks: int = 10_000) -> list[_Request]:
@@ -3841,3 +4132,231 @@ class DataParallelServePool:
     @property
     def hbm_peak_bytes(self) -> int:
         return sum(e.hbm_peak_bytes for e in self.replicas)
+
+
+class DisaggServePool(DataParallelServePool):
+    """Disaggregated prefill/decode serving: ``prefill`` replicas are
+    PREFILL SPECIALISTS (chunked prefill into page-aligned pool
+    blocks, one generated token, never a steady-state decode tick) and
+    ``decode`` replicas are DECODE SPECIALISTS (they adopt migrated
+    page chains and only ever decode).  At equal chip count this cuts
+    BOTH serving tails vs the symmetric pool: TTFT p99 (an arriving
+    prompt never queues behind another replica's decode residents) and
+    decode-stall p99 (a decoding slot never shares its engine with a
+    prefill chunk).
+
+    The MIGRATION PROTOCOL, request by request:
+
+    1. ``submit`` routes the prompt to the least-loaded prefill
+       replica as a ``max_new_tokens=1, migrate_out=True`` request —
+       the prefill leg produces exactly the first token.
+    2. At retirement — BEFORE its pages return to the free list — the
+       prefill engine gathers the request's page chain (one fixed-
+       shape ``export_chain`` dispatch; int8 scales travel with their
+       values), slices it host-side, and stashes it with a sha256
+       content digest, the prompt, its chain-hash prefix keys, and the
+       first token.
+    3. The pool pops the export (exactly-once) and hands it to the
+       least-loaded decode replica: ``import_chain`` verifies the
+       digest, allocates pages, scatters the chain in (one fixed-shape
+       dispatch, pool donated), activates the slot mid-decode, and
+       REGISTERS the prompt pages in its prefix registry — later
+       shared-prefix requests on that replica alias the migrated pages
+       for free.
+    4. Decode proceeds from bit-identical pool bytes: greedy tokens
+       are bit-exact vs the symmetric pool by construction.
+
+    FAILOVER composes: exports are host-side numpy, so a prefill
+    replica dying mid-migration still publishes its finished chains
+    (harvested from the orphan stash), pre-export deaths replay the
+    prompt onto a surviving prefill replica (prefix-cache
+    accelerated), and a decode death replays prompt + accepted tokens
+    through prefill again — each request exactly once, bit-exact.
+    With every decode replica dead the pool degrades to symmetric
+    serving on the prefill side (and vice versa)."""
+
+    def __init__(self, params: dict, cfg, prefill: int = 1,
+                 decode: int = 1, tp: int = 1, **kw):
+        if prefill < 1 or decode < 1:
+            raise ValueError(
+                f"need at least one replica per role, got "
+                f"prefill={prefill} decode={decode}")
+        kw.setdefault("paged", True)
+        super().__init__(params, cfg, dp=prefill + decode, tp=tp, **kw)
+        self.n_prefill, self.n_decode = prefill, decode
+        self.roles = ["prefill"] * prefill + ["decode"] * decode
+        # (pool rid, export) pairs finished at a prefill replica and
+        # awaiting decode capacity; drained every step, re-queued when
+        # the decode side is momentarily full
+        self._pending_migrations: deque = deque()
+        self.migrations = 0
+        self.migrated_pages = 0
+        self.migration_ms: list[float] = []
+
+    def _role_replicas(self, role: str, alive: list[int]) -> list[int]:
+        return [i for i in alive if self.roles[i] == role]
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0,
+               deadline_s: float | None = None) -> int:
+        alive = self._alive()
+        if not alive:
+            raise ReplicaDeadError(
+                "no healthy replicas left: "
+                + "; ".join(f"replica {i}: {r}"
+                            for i, r in self.dead_replicas.items()))
+        pref = self._role_replicas("prefill", alive)
+        dec = self._role_replicas("decode", alive)
+        if pref and dec and max_new_tokens > 1:
+            # the disaggregated fast path: prefill leg emits ONE token
+            i = min(pref, key=self._route_key)
+            local = self.replicas[i].submit(
+                prompt, 1, temperature, migrate_out=True)
+        elif pref and max_new_tokens == 1:
+            # satisfied entirely by prefill — no migration needed
+            i = min(pref, key=self._route_key)
+            local = self.replicas[i].submit(prompt, 1, temperature)
+        else:
+            # degraded: one whole role is dead — serve symmetrically
+            # on whatever survives
+            i = min(alive, key=self._route_key)
+            local = self.replicas[i].submit(prompt, max_new_tokens,
+                                            temperature)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._entries[rid] = _PoolEntry(
+            rid=rid, prompt=np.asarray(prompt, np.int32),
+            max_new=max_new_tokens, temperature=float(temperature),
+            deadline=(time.monotonic() + deadline_s
+                      if deadline_s is not None else None),
+            replica=i, local=local)
+        self._local[(i, local)] = rid
+        return rid
+
+    def _replay_submit(self, replay, remaining: int,
+                       e: "_PoolEntry") -> tuple[int, int]:
+        """Role-aware replay: unfinished work goes back through a
+        prefill replica as a fresh migrate-out leg (prefix-cache
+        accelerated re-prefill of prompt + accepted), falling back to
+        symmetric placement when a whole role is dead."""
+        alive = self._alive()
+        pref = self._role_replicas("prefill", alive)
+        dec = self._role_replicas("decode", alive)
+        if pref and dec and remaining > 1:
+            j = min(pref, key=self._route_key)
+            return j, self.replicas[j].submit(
+                replay, 1, e.temperature, migrate_out=True)
+        j = min(alive, key=self._route_key)
+        return j, self.replicas[j].submit(replay, remaining,
+                                          e.temperature)
+
+    def _finish(self, replica: int, r: _Request, done: list) -> None:
+        """A finisher from a PREFILL replica whose pool budget is not
+        yet satisfied is a migration hand-off, not a completion — pop
+        its export and queue it for a decode replica.  Everything else
+        (decode finishers, satisfied one-token requests, EOS at first
+        token, failed requests) falls through to the base path."""
+        rid = self._local.get((replica, r.rid))
+        if (rid is not None and self.roles[replica] == "prefill"
+                and r.error is None):
+            e = self._entries[rid]
+            eng = self.replicas[replica]
+            exp = eng.take_export(r.rid)
+            hit_eos = (eng.eos_id is not None and r.tokens
+                       and r.tokens[-1] == eng.eos_id)
+            needs_more = e.max_new > len(e.prefix) + len(r.tokens)
+            if needs_more and not hit_eos:
+                self._local.pop((replica, r.rid))
+                if exp is not None:
+                    # first token rides INSIDE the export — e.prefix
+                    # stays as-is so the budget math stays exact
+                    self._pending_migrations.append((rid, exp))
+                else:
+                    # no chain (e.g. a degraded-mode leg landed here):
+                    # bank the tokens and replay the remainder
+                    e.prefix = e.prefix + list(r.tokens)
+                    remaining = e.max_new - len(e.prefix)
+                    replay = np.concatenate(
+                        [e.prompt, np.asarray(e.prefix, np.int32)])
+                    try:
+                        j, new_local = self._replay_submit(
+                            replay, remaining, e)
+                    except ValueError as err:
+                        self._fail_entry(
+                            e, f"replay rejected: {err}", done)
+                        return
+                    e.replica, e.local = j, new_local
+                    self._local[(j, new_local)] = rid
+                return
+        super()._finish(replica, r, done)
+
+    def _drain_migrations(self, done: list) -> None:
+        """Hand every pending export to the least-loaded decode
+        replica.  A full decode side defers the migration to the next
+        step (the export is host memory — nothing on device waits); a
+        dead decode side falls back to any healthy replica."""
+        if not self._pending_migrations:
+            return
+        alive = self._alive()
+        dec = self._role_replicas("decode", alive) or alive
+        pending, self._pending_migrations = \
+            self._pending_migrations, deque()
+        for rid, exp in pending:
+            e = self._entries.get(rid)
+            if e is None:
+                continue   # cancelled / deadline-expired in flight
+            if not dec:
+                self._fail_entry(
+                    e, "no healthy replicas left for migration", done)
+                continue
+            j = min(dec, key=self._route_key)
+            eng = self.replicas[j]
+            remaining = e.max_new - len(e.prefix)
+            sp = None
+            if self._tracer is not None:
+                sp = self._tracer.start_span(
+                    "request.migrate", parent=eng._engine_anchor,
+                    attrs={"rid": rid, "pages": exp["pages"],
+                           "to_replica": j})
+            t0 = time.perf_counter()
+            try:
+                local = eng.import_chain(exp, remaining, e.temperature)
+            except ReplicaDeadError:
+                self._pending_migrations.append((rid, exp))
+                if sp is not None:
+                    sp.set_attr("outcome", "replica_dead")
+                    sp.end()
+                continue
+            except ValueError as err:
+                self._fail_entry(e, f"migration rejected: {err}", done)
+                if sp is not None:
+                    sp.set_attr("outcome", "rejected")
+                    sp.end()
+                continue
+            if local is None:
+                # decode side momentarily out of slots/pages
+                self._pending_migrations.append((rid, exp))
+                if sp is not None:
+                    sp.set_attr("outcome", "deferred")
+                    sp.end()
+                continue
+            dt = (time.perf_counter() - t0) * 1e3
+            self.migrations += 1
+            self.migrated_pages += int(exp["pages"])
+            self.migration_ms.append(dt)
+            _trim_acct(self.migration_ms)
+            if self._metrics is not None:
+                self._metrics.inc("serve_migrated_pages_total",
+                                  float(exp["pages"]))
+                self._metrics.observe("serve_migration_ms", dt)
+            if sp is not None:
+                sp.set_attr("outcome", "migrated")
+                sp.set_attr("ms", round(dt, 3))
+                sp.end()
+            e.replica, e.local = j, local
+            self._local[(j, local)] = rid
+
+    def step(self) -> list[_Request]:
+        done = super().step()
+        self._drain_migrations(done)
+        return done

@@ -96,6 +96,36 @@ name                            kind       meaning
                                            capacity planning budgets
                                            ``max_pages``/``n_slots``
                                            against (ISSUE 10)
+``serve_migrated_pages_total``  counter    KV pages migrated from
+                                           prefill-specialist to
+                                           decode-specialist replicas
+                                           (ISSUE 11)
+``serve_migration_ms``          histogram  wall of one page-chain
+                                           import: digest check +
+                                           scatter + slot activation
+                                           (ISSUE 11)
+``serve_replica_queue_depth``   gauge      per-replica admission queue
+                                           depth (suffixed ``_r<i>``
+                                           per replica; the pool
+                                           router's own signal,
+                                           ISSUE 11)
+``serve_queue_wait_ticks``      histogram  submit → admission in engine
+                                           service rounds — the
+                                           deterministic twin of
+                                           ``serve_queue_wait_ms``
+                                           (schedule-pure; the CPU
+                                           smoke A/B gates on it,
+                                           ISSUE 11)
+``serve_ttft_ticks``            histogram  submit → first token in
+                                           engine service rounds — the
+                                           deterministic twin of
+                                           ``serve_ttft_ms`` (ISSUE 11)
+``serve_decode_stall_work``     histogram  admission + chunk work UNITS
+                                           decode-phase slots waited
+                                           behind in one tick — the
+                                           structural twin of
+                                           ``serve_decode_stall_ms``
+                                           (ISSUE 11)
 ==============================  =========  ============================
 
 Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as
@@ -103,6 +133,8 @@ Chrome/Perfetto JSON, not scraped): ``sched.schedule``, ``sched.bind``,
 ``crishim.inject``, ``engine.start``, ``request`` (attrs:
 ``queue_wait_ms``, ``ttft_ms``, ``token_ms``, ``tokens``),
 ``request.admit``, ``request.prefill_chunk``, ``request.replay``,
+``request.migrate`` (attrs: ``rid``, ``pages``, ``to_replica``,
+``outcome``, ``ms`` — the prefill→decode page-chain hand-off),
 ``request.quarantine``, ``pool.failover``, ``engine.tick``,
 ``engine.dispatch``, ``engine.verify``, ``engine.collect``,
 ``engine.admit``, plus ``sched.<kind>`` instants forwarded from

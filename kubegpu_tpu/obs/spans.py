@@ -6,7 +6,9 @@ whose *propagation token* travels the same road as ``TPU_VISIBLE_CHIPS``
 injection (``KUBETPU_TRACE_CONTEXT``) → serve pod → the engine — so a
 slow request can be attributed phase by phase: queue wait, admission,
 each prefill chunk, each decode/verify tick it rode, quarantine /
-replay / failover hops, TTFT and per-output-token time as span
+replay / failover hops, the prefill→decode page-chain migration
+(``request.migrate``, with page count and hand-off wall under
+disaggregated serving), TTFT and per-output-token time as span
 attributes.
 
 Three deliberate properties:

@@ -80,6 +80,28 @@ def decode_capacity(n_pages: int, t_pad: int, page_size: int) -> int:
     return max(n_pages * page_size - t_pad, 0)
 
 
+def gather_pages(pool: dict, page_ids: jax.Array) -> dict:
+    """Fetch the listed pages from every pool leaf — the KV transfer
+    unit for cross-engine page migration.  Works on the bf16 2-leaf
+    pool and the int8 QTensor 4-leaf pool alike: the page axis is
+    axis 1 on both the [L, pages, Hkv, P, D] value leaves and the
+    [L, pages, Hkv, P] scale leaves, so quantization scales travel
+    with their values.  Padding ids (0) gather the trash page, which
+    is never attended."""
+    return {name: jnp.take(leaf, page_ids, axis=1)
+            for name, leaf in pool.items()}
+
+
+def scatter_pages(pool: dict, chain: dict, page_ids: jax.Array) -> dict:
+    """Write a gathered chain into ``pool`` at ``page_ids`` — the
+    import side of page migration.  ``chain`` leaves must carry the
+    same number of pages as ``page_ids``; padding ids (0) scatter into
+    the trash page (duplicate trash writes race benignly — page 0 is
+    never attended)."""
+    return {name: pool[name].at[:, page_ids].set(chain[name])
+            for name in pool}
+
+
 # ---------------------------------------------------------------------------
 # XLA reference (CPU tests + parity oracle)
 # ---------------------------------------------------------------------------

@@ -1594,11 +1594,15 @@ class DeviceScheduler:
         model (topology sees serving slices as what they are): tp
         psums ride every decode step while dp replicas never exchange
         a byte — so the allocator should spend its contiguous ICI on
-        the tp rings and may scatter replicas freely."""
+        the tp rings and may scatter replicas freely.  A disaggregated
+        gang's role annotation (``serve-role``: prefill | decode)
+        further relaxes tp tightness for prefill specialists, whose
+        collectives hide behind batch compute."""
         if axes is None or pod_workload_kind(pod) != "serving":
             return None
+        from kubegpu_tpu.kubemeta.codec import pod_serve_role
         from kubegpu_tpu.topology.locality import serving_axis_weights
-        return serving_axis_weights(axes)
+        return serving_axis_weights(axes, role=pod_serve_role(pod))
 
     def _request_for_single(self, pod: Pod) -> GangRequest:
         chips = pod.spec.total_chips

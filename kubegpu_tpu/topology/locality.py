@@ -48,11 +48,25 @@ SERVING_AXIS_WEIGHTS = {
     "dp": 0.05,
 }
 
+# Role-split (disaggregated) serving: a PREFILL replica is a
+# throughput-bound batch engine off the token feedback path — its tp
+# collective rides large prefill activations where link time hides
+# behind compute, so tight tp placement matters less than for a
+# DECODE replica, whose per-token psum latency IS the user-visible
+# token time.  Decode keeps the default serving weights.
+PREFILL_ROLE_TP_WEIGHT = 4.0
 
-def serving_axis_weights(axis_sizes: dict[str, int]) -> dict[str, float]:
+
+def serving_axis_weights(axis_sizes: dict[str, int],
+                         role: str | None = None) -> dict[str, float]:
     """Axis weights for a SERVING gang (see SERVING_AXIS_WEIGHTS):
-    tp collectives dominate, replica axes are nearly free."""
-    return {k: SERVING_AXIS_WEIGHTS.get(k, 1.0) for k in axis_sizes}
+    tp collectives dominate, replica axes are nearly free.  ``role``
+    ("prefill" | "decode" | None) adjusts the tp weight for
+    disaggregated gangs — prefill tolerates looser tp placement."""
+    w = {k: SERVING_AXIS_WEIGHTS.get(k, 1.0) for k in axis_sizes}
+    if role == "prefill" and "tp" in w:
+        w["tp"] = PREFILL_ROLE_TP_WEIGHT
+    return w
 
 
 def resolve_axis_weights(

@@ -376,12 +376,13 @@ def test_expected_signature_sets_are_wellformed():
     from kubegpu_tpu.analysis.jaxpr_audit import expected_signatures
     exp = expected_signatures()
     assert set(exp) == {"plain", "spec"}
-    assert len(exp["plain"]) == 6 and len(exp["spec"]) == 6
+    assert len(exp["plain"]) == 8 and len(exp["spec"]) == 6
     for sig in exp["plain"] | exp["spec"]:
         name = sig.split("(", 1)[0]
         assert name in {"decode_block", "decode_fused", "prefill_wave",
                         "prefill_chunk", "adopt_wave", "activate_slot",
-                        "verify_block", "verify_fused"}, sig
+                        "verify_block", "verify_fused", "export_chain",
+                        "import_chain"}, sig
 
 
 @pytest.mark.slow
@@ -389,7 +390,7 @@ def test_compile_census_matches_expected_set():
     from kubegpu_tpu.analysis.jaxpr_audit import compile_census
     findings, summary = compile_census()
     assert findings == [], "\n".join(f.message for f in findings)
-    assert summary["signatures_total"] == 12
+    assert summary["signatures_total"] == 14
     for label in ("plain", "spec"):
         eng = summary["engines"][label]
         assert eng["observed"] == eng["expected"]

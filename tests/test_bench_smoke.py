@@ -191,13 +191,50 @@ def test_serving_bench_smoke_parses_and_carries_keys():
     # recompilation gate reads.
     cc = doc["cb_compile_census"]
     assert cc["violations"] == 0, cc["violation_messages"]
-    assert cc["signatures_total"] == 12
+    assert cc["signatures_total"] == 14
     for name in ("decode_block", "decode_fused", "prefill_wave",
                  "prefill_chunk", "adopt_wave", "activate_slot",
-                 "verify_block", "verify_fused"):
+                 "verify_block", "verify_fused", "export_chain",
+                 "import_chain"):
         row = cc["per_executable"][name]
         assert row["signatures"] >= 1, name
         assert row["first_compile_ms"] > 0, name
     for label in ("plain", "spec"):
         assert cc["engines"][label]["observed"] == \
             cc["engines"][label]["expected"]
+
+    # disaggregated prefill/decode serving (ISSUE 11): the equal-chip
+    # A/B must complete the window BIT-EXACT on the role-split pool
+    # with every request actually migrating (prefill leg emits one
+    # token, decode leg adopts the page chain), and BOTH serving tails
+    # the tentpole gates on — TTFT p99 and decode-stall p99 — must
+    # drop vs the symmetric dp pool.
+    if len(jax.devices()) >= 2:
+        dg = doc["cb_disagg"]
+        assert dg["protocol"] == "equal_chip_ab"
+        assert dg["bit_exact"] is True
+        assert dg["tokens"] == dg["requests"] * dg["new_tokens"]
+        assert dg["disagg"]["migrations"] == dg["requests"]
+        assert dg["disagg"]["migrated_pages"] >= dg["requests"]
+        assert dg["disagg"]["migration_ms"]["count"] == \
+            dg["disagg"]["migrations"]
+        for key in ("ttft_p99_ms", "decode_stall_p99_ms",
+                    "queue_wait_p99_ms"):
+            assert dg["symmetric"][key] > 0, key
+            assert dg["disagg"][key] > 0, key
+        # the tail gates run on the DETERMINISTIC twins (engine service
+        # rounds / work units — a pure function of the admission
+        # schedule): the ms tails above are real wall clocks and read
+        # as weather on a loaded CI host.  Structurally: a prompt on
+        # the role-split pool only ever queues behind other PREFILLS
+        # (symmetric slots are held hostage through whole decodes), and
+        # the decode-specialist replica never interleaves chunk work
+        # with decoding slots at all.
+        assert dg["ttft_ticks_reduction_x"] > 1.0, \
+            "role split must cut the TTFT tail"
+        assert dg["queue_wait_ticks_reduction_x"] > 1.0, \
+            "role split must cut the queue-wait tail"
+        assert dg["symmetric"]["decode_stall_work_p99"] > 0.0
+        assert dg["disagg"]["decode_stall_work_p99"] == 0.0, \
+            "a decode-specialist replica must never stall decoding " \
+            "slots behind prefill chunk work"

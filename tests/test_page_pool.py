@@ -628,3 +628,169 @@ class TestDonatedHandleHygiene:
         assert not stale.is_deleted()
         np.asarray(stale)            # must not raise
         assert len(eng.drain()) == 1
+
+
+class TestChainMigration:
+    """KV page-chain migration (ISSUE 11): the export is a host-side
+    value decoupled from the source pool, the import lands BIT-EXACT
+    pool bytes on the destination under full refcount law, and a
+    prefill-replica kill mid-migration still completes every request
+    exactly once with bit-exact tokens."""
+
+    def _mk(self, cfg, params, **kw):
+        kw.setdefault("prefix_cache", True)
+        kw.setdefault("chunked_prefill", True)
+        kw.setdefault("prefill_chunk", 8)
+        return make_engine(cfg, params, **kw)
+
+    @pytest.mark.parametrize("kv_int8", [False, True],
+                             ids=["bf16", "int8"])
+    def test_export_mutate_import_bit_exact_refcounts(
+            self, tiny, kv_int8):
+        """export chain → churn the SOURCE pool (its freed pages get
+        reused by new traffic) → import into a fresh engine: the
+        destination pages equal the export byte-for-byte (int8 scales
+        included), refcounts hold on both pools, and the adopted
+        request decodes to the same greedy tokens as a never-migrated
+        run.  Donation is ON (the make_engine default) on every engine
+        involved."""
+        cfg, params = tiny
+        src = self._mk(cfg, params, kv_int8=kv_int8)
+        dst = self._mk(cfg, params, kv_int8=kv_int8)
+        assert src._donate and dst._donate
+        prompt = [(i * 7 + 2) % cfg.vocab_size for i in range(12)]
+        total = 6
+
+        # never-migrated reference: same prompt, full budget
+        ref_eng = self._mk(cfg, params, kv_int8=kv_int8)
+        ref_eng.submit(prompt, total)
+        ref = ref_eng.drain()[0].tokens
+
+        rid = src.submit(prompt, 1, migrate_out=True)
+        done = src.drain()
+        assert [r.rid for r in done] == [rid]
+        assert done[0].tokens == ref[:1]
+        exp = src.take_export(rid)
+        assert exp is not None and exp["pages"] == 2   # tpad 16, P=8
+        assert src.take_export(rid) is None            # exactly-once
+        frozen = {n: np.asarray(a).copy()
+                  for n, a in exp["chain"].items()}
+        if kv_int8:
+            assert "k_scale" in frozen and "v_scale" in frozen
+
+        # churn the source: freed pages are reallocated and rewritten
+        for j in range(4):
+            src.submit([(41 + 5 * j + 3 * i) % cfg.vocab_size
+                        for i in range(12)], 4)
+        src.drain()
+        check_refcount_invariants(src)
+        for n, a in exp["chain"].items():
+            assert (np.asarray(a) == frozen[n]).all(), \
+                f"export leaf {n} mutated by source churn"
+
+        # a tampered chain must be refused (content digest)
+        bad = dict(exp, chain={n: np.array(a)
+                               for n, a in exp["chain"].items()})
+        bad["chain"]["k"] = bad["chain"]["k"].copy()
+        bad["chain"]["k"].flat[0] += 1
+        with pytest.raises(ValueError, match="digest"):
+            dst.import_chain(bad, max_new_tokens=total)
+
+        local = dst.import_chain(exp, max_new_tokens=total)
+        assert local is not None
+        check_refcount_invariants(dst)
+        slot = next(s for s, r in dst.slot_req.items()
+                    if r.rid == local)
+        pages = dst._slot_pages[slot][:exp["pages"]]
+        for n, leaf in dst.pool.items():
+            got = np.asarray(leaf)[:, pages]
+            assert (got == frozen[n]).all(), \
+                f"imported pages differ on leaf {n}"
+        out = dst.drain()
+        assert [r.rid for r in out] == [local]
+        assert out[0].tokens == ref, "migrated decode diverged"
+        check_refcount_invariants(dst)
+
+    def test_migration_composes_spec_fused(self, tiny):
+        """The full serving matrix through the role-split pool: spec
+        γ>0, fused K=4, prefix cache, chunked prefill, donation — every
+        request migrates and the tokens are bit-exact vs the symmetric
+        pool running the same matrix (greedy speculation emits the full
+        model's argmax by construction, migration moves exact pool
+        bytes, so the composition cannot drift)."""
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        from kubegpu_tpu.models.serve import (
+            DataParallelServePool,
+            DisaggServePool,
+        )
+        cfg, params = tiny
+        kw = dict(n_slots=2, max_len=32, stride=2,
+                  prompt_buckets=(16,), paged=True, page_size=8,
+                  prefix_cache=True, chunked_prefill=True,
+                  prefill_chunk=8, spec_gamma=2, draft_layers=1,
+                  fused_ticks=4)
+        base = np.arange(2, 18)
+        stream = [((base + 3 * i) % cfg.vocab_size, 8)
+                  for i in range(4)]
+
+        def run(cls, **extra):
+            pool = cls(params, cfg, **extra, **kw)
+            rids = [pool.submit(p, n) for p, n in stream]
+            seen = {r.rid: list(r.tokens) for r in pool.drain()
+                    if r.error is None}
+            return pool, [seen.get(r) for r in rids]
+
+        _, sym_toks = run(DataParallelServePool, dp=2, tp=1)
+        dis, dis_toks = run(DisaggServePool, prefill=1, decode=1,
+                            tp=1)
+        assert all(t is not None and len(t) == 8 for t in sym_toks)
+        assert dis_toks == sym_toks, "composition lost bit-exactness"
+        assert dis.migrations == len(stream)
+
+    def test_chaos_prefill_kill_mid_migration_exactly_once(self, tiny):
+        """DisaggServePool under a seeded prefill-replica kill while
+        migrations are in flight: exports that already landed are host
+        memory (they survive the death), unfinished prefills replay —
+        every request completes exactly once, bit-exact vs the fault-
+        free disaggregated run."""
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        from kubegpu_tpu.models.serve import DisaggServePool
+        from kubegpu_tpu.obs.chaos import ChaosEvent, ChaosInjector
+        cfg, params = tiny
+        base = np.arange(2, 18)
+        stream = [((base + 3 * i) % cfg.vocab_size, 8)
+                  for i in range(6)]
+
+        def run(chaos=None):
+            pool = DisaggServePool(
+                params, cfg, prefill=1, decode=1, tp=1, chaos=chaos,
+                n_slots=2, max_len=32, stride=2, prompt_buckets=(16,),
+                paged=True, page_size=8, prefix_cache=True,
+                chunked_prefill=True, prefill_chunk=8)
+            rids = [pool.submit(p, n) for p, n in stream]
+            seen: dict[int, list[int] | None] = {}
+            dup = 0
+            for r in pool.drain():
+                if r.rid in seen:
+                    dup += 1
+                seen[r.rid] = (None if r.error is not None
+                               else list(r.tokens))
+            return pool, [seen.get(r) for r in rids], dup
+
+        pool0, base_toks, dup0 = run()
+        assert dup0 == 0
+        assert all(t is not None and len(t) == 8 for t in base_toks)
+        assert pool0.migrations == len(stream)
+
+        pool, toks, dup = run(chaos={0: ChaosInjector(
+            [ChaosEvent(tick=2, kind="kill_replica")])})
+        assert dup == 0, "a request completed twice across the kill"
+        assert toks == base_toks, "replayed stream lost bit-exactness"
+        assert pool.failovers == 1
+        # the prefill role died: late arrivals served degraded on the
+        # decode replica, but anything exported pre-kill migrated
+        assert pool.migrations <= len(stream)
