@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
-        fused-smoke hbm-smoke disagg-smoke analyze clean
+        fused-smoke hbm-smoke disagg-smoke slo-smoke analyze clean
 
 all: native
 
@@ -91,6 +91,29 @@ disagg-smoke: analyze           # ISSUE 11 disaggregated serving: page-
 		assert r['queue_wait_ticks_reduction_x'] > 1.0, r; \
 		assert r['symmetric']['decode_stall_work_p99'] > 0.0, r; \
 		assert r['disagg']['decode_stall_work_p99'] == 0.0, r"
+
+slo-smoke: analyze              # ISSUE 13 overload robustness: the
+	# seeded bursty overload trace through the loadgen harness +
+	# preempt/park/resume unit tests, then the FIFO-vs-tiered A/B —
+	# top-tier goodput-under-SLO >= 1.3x at equal chips, zero
+	# lost/duplicated requests, every completed request bit-exact vs
+	# an unloaded reference (gates on the tick twins; ms is weather).
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_loadgen.py -q
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_serve_chaos.py -q -k "preempt or Tier or tier"
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -c "import json; \
+		from kubegpu_tpu.benchmark import run_serving_bench_smoke; \
+		row = run_serving_bench_smoke(legs=['cb_slo_goodput']); \
+		print(json.dumps(row, indent=1)); \
+		r = row['cb_slo_goodput']; \
+		assert r['bit_exact'], 'survivors diverged'; \
+		assert r['lost'] == 0 and r['duplicated'] == 0, r; \
+		assert r['top_tier_goodput_ratio_x'] >= 1.3, r; \
+		assert r['tiered']['top_tier']['attainment'] >= 0.9, r"
 
 trace-smoke:                    # ISSUE 6 observability: a traced serve
 	# window must yield ONE connected span tree from extender bind

@@ -2112,6 +2112,125 @@ def _cb_disagg_bench(params, cfg, slots: int, prompt: int, new: int,
     }
 
 
+def _cb_slo_goodput_bench(params, cfg) -> dict:
+    """SLO-guarded overload A/B (ISSUE 13 tentpole): the SAME seeded
+    open-loop overload trace (bursty Poisson arrivals, long-tail
+    lengths, shared prefixes, 3 priority tiers) through one engine
+    twice at equal chips — once with every request submitted FIFO at
+    tier 0 (shedding is the only overload control), once with tiered
+    admission (strict across tiers, EDF within) + low-priority decode
+    preemption.  The gate is the headline degradation story: the
+    tiered leg's TOP-TIER goodput-under-SLO (tokens/tick from
+    requests that met their tier's TTFT + per-token tick SLOs) must
+    be >= 1.3x the FIFO leg's, with zero lost/duplicated requests
+    and every completed request BIT-EXACT against an unloaded
+    reference run — preempt/park/resume is token-identical by the
+    greedy-replay construction.  Tick-denominated numbers gate
+    (deterministic twins, PR 9); wall clocks ride along as weather."""
+    from kubegpu_tpu.loadgen import (
+        LoadSpec,
+        TierSpec,
+        run_load,
+        synth_trace,
+    )
+    from kubegpu_tpu.models.serve import ContinuousBatcher
+    from kubegpu_tpu.obs.metrics import MetricsRegistry
+
+    TIERS = (TierSpec("gold", ttft_slo_ticks=8, token_slo_ticks=4.0,
+                      share=0.3),
+             TierSpec("std", ttft_slo_ticks=30, token_slo_ticks=8.0,
+                      share=0.4),
+             TierSpec("batch", ttft_slo_ticks=10 ** 6,
+                      token_slo_ticks=10 ** 6, share=0.3))
+    spec = LoadSpec(seed=7, n_requests=36, mean_iat_ticks=0.9,
+                    burst=True, prompt_len_max=8, out_len_min=2,
+                    out_len_max=10, prefix_share=0.25, prefix_len=4,
+                    vocab=min(48, cfg.vocab_size), tiers=TIERS)
+    trace = synth_trace(spec)
+    TAILS = {"ttft_p99_ms": "serve_ttft_ms",
+             "queue_wait_p99_ms": "serve_queue_wait_ms",
+             "ttft_p99_ticks": "serve_ttft_ticks",
+             "queue_wait_p99_ticks": "serve_queue_wait_ticks"}
+    eng_kw = dict(n_slots=2, stride=2, prompt_buckets=(8,),
+                  paged=True, page_size=8, total_pages=8,
+                  prefix_cache=True)
+
+    def leg(tiered):
+        reg = MetricsRegistry()
+        eng = ContinuousBatcher(params, cfg, metrics=reg, **eng_kw)
+        eng.warmup()   # compile outside the measured window
+        rep = run_load(eng, trace, TIERS, tiered=tiered, metrics=reg)
+        hists = reg.snapshot()["histograms"]
+        tails = {k: (round(hists[m]["p99"], 3) if m in hists
+                     else None)
+                 for k, m in TAILS.items()}
+        return eng, rep, tails
+
+    fifo_eng, fifo, fifo_tails = leg(tiered=False)
+    tier_eng, tiered, tier_tails = leg(tiered=True)
+
+    # unloaded reference: every unique (prompt, budget) alone on a
+    # fresh engine — the bit-exact-survivor contract's ground truth
+    ref_eng = ContinuousBatcher(params, cfg, **eng_kw)
+    ref: dict = {}
+    for item in trace:
+        key = (item["prompt"].tobytes(), item["max_new"])
+        if key in ref:
+            continue
+        rid = ref_eng.submit(item["prompt"], item["max_new"])
+        ref[key] = {r.rid: list(r.tokens)
+                    for r in ref_eng.drain()}[rid]
+    bit_exact = all(
+        rec["tokens"] == ref[(rec["prompt"].tobytes(),
+                              rec["max_new"])]
+        for rep_ in (fifo, tiered) for rec in rep_.records
+        if rec["completed"])
+
+    def leg_dict(rep, eng, tails):
+        return {
+            "goodput_tokens_per_tick":
+                round(rep.goodput_tokens_per_tick, 4),
+            "goodput_tokens_per_s_weather":
+                round(rep.goodput_tokens_per_s, 1),
+            "slo_attainment": round(rep.slo_attainment, 4),
+            "top_tier": {
+                "attainment": rep.per_tier[0]["attainment"],
+                "goodput_tokens": rep.per_tier[0]["goodput_tokens"],
+            },
+            "per_tier_attainment": [rep.per_tier[k]["attainment"]
+                                    for k in range(len(TIERS))],
+            "ticks": rep.ticks,
+            "completed": rep.completed, "failed": rep.failed,
+            "preempted": eng.requests_preempted,
+            "resumed": eng.requests_resumed,
+            "deadline_misses": eng.deadline_misses,
+            "shed_by_reason": dict(eng.shed_by_reason),
+            **tails,
+            "wall_ms_raw_weather": round(rep.wall_s * 1e3, 1),
+        }
+
+    fifo_top = fifo.per_tier[0]["goodput_tokens"] / max(fifo.ticks, 1)
+    tier_top = tiered.per_tier[0]["goodput_tokens"] \
+        / max(tiered.ticks, 1)
+    return {
+        "protocol": "same_trace_ab",
+        "requests": len(trace),
+        "tiers": [{"name": t.name,
+                   "ttft_slo_ticks": t.ttft_slo_ticks,
+                   "token_slo_ticks": t.token_slo_ticks}
+                  for t in TIERS],
+        "fifo": leg_dict(fifo, fifo_eng, fifo_tails),
+        "tiered": leg_dict(tiered, tier_eng, tier_tails),
+        # deterministic (tick-denominated) gate: tiered admission +
+        # preemption must buy the top tier >= 1.3x goodput-under-SLO
+        "top_tier_goodput_ratio_x":
+            round(tier_top / fifo_top, 3) if fifo_top else None,
+        "bit_exact": bit_exact,
+        "lost": fifo.lost + tiered.lost,
+        "duplicated": fifo.duplicated + tiered.duplicated,
+    }
+
+
 def run_serving_bench_smoke(legs=None) -> dict:
     """Tiny-config run of ONLY the serving fast-path bench legs
     (prefix cache, chunked-prefill stall, equal-HBM mixed-length A/B,
@@ -2180,6 +2299,7 @@ def run_serving_bench_smoke(legs=None) -> dict:
         "cb_disagg": lambda: _cb_disagg_bench(
             params, cfg, slots=2, prompt=16, new=24, stride=2, page=8,
             chunk=8, reqs=8),
+        "cb_slo_goodput": lambda: _cb_slo_goodput_bench(params, cfg),
         "cb_compile_census": _cb_compile_census_bench,
     }
     if legs is not None:
@@ -2766,6 +2886,35 @@ def summarize_bench(out: dict) -> dict:
                 ("cb", "continuous_batching", "spec_decode")))}
         if tails:
             s["serving_tails"] = tails
+        # goodput / SLO-attainment columns (ISSUE 13 sat.) — same
+        # probing as the tail table: [goodput tokens/tick,
+        # SLO attainment] per serving row (or per leg).  Sparse by
+        # design: rows that never drove the load harness are omitted
+        # (an all-null column would burn the driver line's byte
+        # budget saying nothing)
+        GOOD_KEYS = ("goodput_tokens_per_tick", "slo_attainment")
+
+        def _goodput_cols(row):
+            legs = {name: node for name, node in row.items()
+                    if isinstance(node, dict)
+                    and any(g in node for g in GOOD_KEYS)}
+            if legs:
+                return {name: [node.get(g) for g in GOOD_KEYS]
+                        for name, node in legs.items()}
+            if any(g in row for g in GOOD_KEYS):
+                return [row.get(g) for g in GOOD_KEYS]
+            return None
+
+        goodput = {
+            name: cols
+            for name, row in list(fam.items()) + [("serving", sv)]
+            if isinstance(row, dict) and "skipped" not in row
+            and "error" not in row
+            and (name == "serving" or name.startswith(
+                ("cb", "continuous_batching", "spec_decode")))
+            and (cols := _goodput_cols(row)) is not None}
+        if goodput:
+            s["serving_goodput"] = goodput
     elif isinstance(m, dict):
         s["model"] = {"error": str(m["error"])[:120]}
 

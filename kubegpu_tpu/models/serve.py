@@ -1377,6 +1377,25 @@ class _Request:
     not_before_tick: int = 0            # backoff gate for replays
     deadline: float | None = None       # time.monotonic() cutoff
     error: str | None = None            # set when the request FAILED
+    # -- SLO-guarded admission (ISSUE 13) -------------------------
+    # ``tier`` orders admission strictly (0 = most critical); within
+    # a tier the queue is EDF on ``deadline_tick`` (a step-count
+    # cutoff — deterministic, unlike the wall-clock ``deadline``,
+    # which prunes but never reorders).  ``seq`` is the engine-wide
+    # enqueue sequence that makes the sort stable-FIFO within
+    # (tier, deadline) — replays and parked resumes re-draw it, so a
+    # re-queued request never jumps its tier-mates.
+    tier: int = 0
+    tenant: str = ""                    # quota bucket ("" = unmetered)
+    seq: int = 0
+    deadline_tick: int | None = None    # _step_count cutoff
+    preemptions: int = 0                # park/resume cycles survived
+    resuming: bool = False              # parked; next admit = resume
+    # engine-tick lifecycle stamps (the load harness's deterministic
+    # SLO clock: TTFT = first - submit, decode rate from finish)
+    submit_tick: int = -1
+    first_tick: int = -1
+    finish_tick: int = -1
 
     @property
     def remaining_new(self) -> int:
@@ -1442,7 +1461,8 @@ class ContinuousBatcher:
                  debug_invariants: bool = False,
                  tracer=None, trace_ctx=None,
                  fused_ticks: int = 1, eos_id: int | None = None,
-                 donate: bool = True):
+                 donate: bool = True,
+                 tenant_quotas: dict | None = None):
         # model families: a MoEConfig serves through the same engine —
         # its Llama backbone drives attention/cache shapes, the routed
         # expert FFN rides the engine's ffn hook (VERDICT r4 weak #6:
@@ -1835,6 +1855,22 @@ class ContinuousBatcher:
         self.requests_retried = 0
         self.requests_shed = 0
         self.dispatch_failures = 0
+        # -- SLO-guarded admission (ISSUE 13) -------------------------
+        # ``_tier_mode`` flips on at the first submit carrying a tier
+        # > 0 or a tick deadline; until then the queue is plain FIFO
+        # and every pre-existing schedule is bit-identical.  Tenant
+        # quotas bound IN-FLIGHT (queued + resident) requests per
+        # tenant — an over-quota submit is shed at the door, before
+        # any prefill work.
+        self._seq = 0
+        self._tier_mode = False
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self._tenant_load: dict[str, int] = {}
+        self._rid_tenant: dict[int, str] = {}
+        self.requests_preempted = 0
+        self.requests_resumed = 0
+        self.deadline_misses = 0
+        self.shed_by_reason: dict[str, int] = {}
         self.replay_ms: list[float] = []
         self._jseed = seed
         # step counter for replay backoff: advances every step() even
@@ -2059,7 +2095,9 @@ class ContinuousBatcher:
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
                deadline_s: float | None = None,
-               migrate_out: bool = False) -> int:
+               migrate_out: bool = False, tier: int = 0,
+               tenant: str = "",
+               deadline_ticks: int | None = None) -> int:
         """Enqueue a request.  ``prompt``: 1-D int sequence;
         ``temperature`` 0 decodes greedily, > 0 samples;
         ``deadline_s`` (optional) cancels the request if it has not
@@ -2068,10 +2106,25 @@ class ContinuousBatcher:
         ``migrate_out`` marks the request for page-chain export at
         retirement (the prefill-specialist leg of disaggregated
         serving): its pool pages are gathered host-side just before
-        release and published via :meth:`take_export`."""
+        release and published via :meth:`take_export`.
+
+        SLO-guarded admission (ISSUE 13): ``tier`` is the priority
+        tier (0 = most critical; admission is strict across tiers and
+        EDF within one), ``tenant`` the quota bucket (an over-quota
+        submit is shed at the door with a ``quota``-tagged reason,
+        surfaced FAILED by the next step()), ``deadline_ticks`` a
+        deterministic step-count deadline that both prunes the
+        request before prefill once expired AND orders it within its
+        tier (the wall-clock ``deadline_s`` only prunes — wall time
+        is weather, so it never drives the schedule)."""
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if tier < 0:
+            raise ValueError(f"tier must be >= 0, got {tier}")
+        if deadline_ticks is not None and deadline_ticks < 1:
+            raise ValueError(
+                f"deadline_ticks must be >= 1, got {deadline_ticks}")
         if migrate_out and not self.paged:
             raise ValueError(
                 "migrate_out needs the paged pool (page chains are "
@@ -2128,13 +2181,16 @@ class ContinuousBatcher:
                        max_new_tokens=max_new_tokens,
                        temperature=float(temperature),
                        prefix_keys=keys, prompt=prompt_np,
-                       admit_len=t,
+                       admit_len=t, tier=int(tier), tenant=str(tenant),
                        deadline=(time.monotonic() + deadline_s
-                                 if deadline_s is not None else None))
+                                 if deadline_s is not None else None),
+                       deadline_tick=(self._step_count + deadline_ticks
+                                      if deadline_ticks is not None
+                                      else None))
         self._next_rid += 1
-        if migrate_out:
-            self._migrate_out.add(req.rid)
-        self.queue.append((req, padded))
+        req.submit_tick = self._tick
+        if tier > 0 or deadline_ticks is not None:
+            self._tier_mode = True
         if self._tracer is not None or self._metrics is not None:
             self._submit_ts[req.rid] = time.perf_counter()
             self._submit_tick[req.rid] = self._tick
@@ -2142,8 +2198,26 @@ class ContinuousBatcher:
             sp = self._tracer.start_span(
                 "request", parent=self._engine_anchor,
                 attrs={"rid": req.rid, "prompt_len": t,
-                       "max_new_tokens": max_new_tokens})
+                       "max_new_tokens": max_new_tokens,
+                       "tier": int(tier)})
             self._req_spans[req.rid] = sp
+        quota = self.tenant_quotas.get(req.tenant) if req.tenant else None
+        if (quota is not None
+                and self._tenant_load.get(req.tenant, 0) >= quota):
+            # over-quota: rejected at the door — never queued, never
+            # prefilled; surfaced FAILED by the next step() return
+            self._shed(req, f"tenant {req.tenant!r} over quota "
+                       f"({quota} in flight)", reason="quota")
+            return req.rid
+        if req.tenant:
+            self._rid_tenant[req.rid] = req.tenant
+            self._tenant_load[req.tenant] = \
+                self._tenant_load.get(req.tenant, 0) + 1
+        if migrate_out:
+            self._migrate_out.add(req.rid)
+        req.seq = self._seq
+        self._seq += 1
+        self.queue.append((req, padded))
         return req.rid
 
     # -- the engine tick ------------------------------------------------
@@ -2233,17 +2307,65 @@ class ContinuousBatcher:
             self._prefix_cache[key] = p
             self._page_key[p] = key
 
-    def _shed(self, req: _Request, why: str) -> None:
+    def _shed(self, req: _Request, why: str,
+              reason: str = "pressure") -> None:
         """Graceful degradation: fail ONE admission instead of letting
         it deadlock the FIFO queue (it is surfaced as a FAILED request
-        by the next step() return, never silently dropped)."""
+        by the next step() return, never silently dropped).
+        ``reason`` tags the shed cause — ``pressure`` (pool/bucket
+        exhaustion), ``quota`` (tenant over its in-flight cap),
+        ``deadline`` (pruned from the queue before prefill) — so the
+        breakdown separates overload policy from capacity faults."""
         req.done = True
         req.error = why
         self.requests_shed += 1
+        # ktp: allow(KTP005) keyed by the 3 fixed reason strings
+        self.shed_by_reason[reason] = \
+            self.shed_by_reason.get(reason, 0) + 1
         if self._metrics is not None:
             self._metrics.inc("serve_requests_shed")
+            self._metrics.inc("serve_requests_shed" + f"_{reason}")
+            if self._tier_mode:
+                self._metrics.inc("serve_requests_shed"
+                                  + f"_t{req.tier}")
         self._failed.append(req)
         self._finish_request_trace(req)
+
+    def _note_resume(self, req: _Request, slot: int) -> None:
+        """A parked (preempted) request just re-entered a slot: its
+        replay prefill of prompt + accepted tokens is the bit-exact
+        greedy resume.  Counted once per park/resume cycle."""
+        if not req.resuming:
+            return
+        req.resuming = False
+        self.requests_resumed += 1
+        if self._metrics is not None:
+            self._metrics.inc("serve_requests_resumed")
+        if self._tracer is not None:
+            self._tracer.instant(
+                "request.resume", self._req_spans.get(req.rid),
+                attrs={"rid": req.rid, "slot": slot,
+                       "tier": req.tier,
+                       "preemptions": req.preemptions})
+
+    def _sort_queue(self) -> None:
+        """Tier-strict, EDF-within-tier admission order: sort the
+        queue by (tier, deadline_tick, seq).  Strict across tiers —
+        a tier-k request never admits while a tier-(k-1) request is
+        admittable; EDF within a tier on the DETERMINISTIC tick
+        deadline (requests without one sort after every request with
+        one, in FIFO ``seq`` order, so the untiered engine's schedule
+        is exactly the FIFO it always was).  The wall-clock
+        ``deadline_s`` never participates: wall time is weather and
+        must not drive the schedule the deterministic twins gate."""
+        if len(self.queue) > 1:
+            self.queue = deque(sorted(
+                self.queue,
+                key=lambda e: (e[0].tier,
+                               e[0].deadline_tick
+                               if e[0].deadline_tick is not None
+                               else float("inf"),
+                               e[0].seq)))
 
     # -- request tracing hooks (ISSUE 6) --------------------------------
     # Callers gate on ``self._tracer is not None or self._metrics is
@@ -2264,6 +2386,13 @@ class ContinuousBatcher:
         if k_sub is not None and self._metrics is not None:
             self._metrics.observe("serve_queue_wait_ticks",
                                   float(self._tick - k_sub))
+            if self._tier_mode:
+                # per-tier twin (``_t<k>`` suffix): the degradation
+                # story in one histogram family — under overload the
+                # low tiers absorb the queueing, the top tier doesn't
+                self._metrics.observe("serve_queue_wait_ticks"
+                                      + f"_t{req.tier}",
+                                      float(self._tick - k_sub))
         if self._tracer is None:
             return
         sp = self._req_spans.get(req.rid)
@@ -2275,6 +2404,8 @@ class ContinuousBatcher:
 
     def _trace_first_token(self, req: _Request) -> None:
         """TTFT: first generated token consumed on the host."""
+        if req.first_tick < 0:
+            req.first_tick = self._tick
         if req.rid in self._first_tok_ts:
             return   # replayed request — TTFT already stamped
         now = time.perf_counter()
@@ -2297,6 +2428,19 @@ class ContinuousBatcher:
         """Close the request span (idempotent — pops its state) with
         TTFT / per-output-token time attributes; called wherever a
         request reaches a terminal state (retire/shed/cancel/fail)."""
+        if req.finish_tick < 0:
+            req.finish_tick = self._tick
+        ten = self._rid_tenant.pop(req.rid, None)
+        if ten is not None:
+            # terminal = the tenant's in-flight quota slot frees (this
+            # pop makes the release exactly-once across re-entries);
+            # idle tenants evict so the dict stays bounded by the
+            # live tenant set
+            left = max(0, self._tenant_load.get(ten, 1) - 1)
+            if left:
+                self._tenant_load[ten] = left
+            else:
+                self._tenant_load.pop(ten, None)
         t_first = self._first_tok_ts.pop(req.rid, None)
         self._submit_ts.pop(req.rid, None)
         self._submit_tick.pop(req.rid, None)
@@ -2343,6 +2487,24 @@ class ContinuousBatcher:
         prefill_wave, adopt_wave = self._fns[1], self._fns[2]
         free = deque(s for s in range(self.n_slots)
                      if s not in self.slot_req)
+        if self._tier_mode:
+            # tier-strict + EDF admission order (FIFO until the first
+            # tiered submit — the sort key degenerates to ``seq``)
+            self._sort_queue()
+            if self.queue and not free:
+                # slot pressure: the most critical queued request
+                # outranks a resident lower-tier decoder — park the
+                # lowest-priority victim(s) and admit into its slot
+                req0, p0 = self.queue[0]
+                if req0.not_before_tick <= self._step_count:
+                    need = 0
+                    if self.paged:
+                        need = (self._pages_needed(req0.remaining_new,
+                                                   p0.shape[1])
+                                - self._prefix_hit_run(req0))
+                    free.extend(sorted(
+                        self._maybe_preempt(req0, need,
+                                            need_slot=True)))
         while free and self.queue:
             req0, p0 = self.queue[0]
             if req0.not_before_tick > self._step_count:
@@ -2369,6 +2531,17 @@ class ContinuousBatcher:
                                f"pages, pool has {self.total_pages}")
                     continue
                 if (need0 - hits0) > self._available_pages():
+                    if self._tier_mode:
+                        # page pressure: park lower-priority decoders
+                        # before making a critical admission wait
+                        freed = self._maybe_preempt(
+                            req0, need0 - hits0, need_slot=False)
+                        if freed:
+                            free.extend(sorted(freed))
+                            # parked victims re-entered the queue —
+                            # restore tier order before re-evaluating
+                            self._sort_queue()
+                            continue
                     break
                 # prefix-aliased tails and long prompts (chunked mode)
                 # admit per-slot through the chunk path — no wave
@@ -2469,6 +2642,7 @@ class ContinuousBatcher:
                 self.slot_req[slot] = req
                 self._await_first.add(slot)
                 self.emitted_tokens += 1
+                self._note_resume(req, slot)
                 if remaining <= 1:
                     req.done = True
             if self._tracer is not None or self._metrics is not None:
@@ -2518,6 +2692,7 @@ class ContinuousBatcher:
         }
         self.slot_req[slot] = req
         self._set_active(slot, False)
+        self._note_resume(req, slot)
         if self._tracer is not None or self._metrics is not None:
             self._trace_admit(req, slot, "chunk")
 
@@ -2663,15 +2838,30 @@ class ContinuousBatcher:
                 "request.replay", self._req_spans.get(req.rid),
                 attrs={"rid": req.rid, "retries": req.retries,
                        "why": why})
+        req.not_before_tick = self._step_count \
+            + self._backoff_ticks(req)
+        if not self._requeue_host(req, "replay"):
+            return
+        self.requests_retried += 1
+        if self._metrics is not None:
+            self._metrics.inc("serve_requests_retried")
+
+    def _requeue_host(self, req: _Request, what: str) -> bool:
+        """Rebuild a host-side re-admission (prompt + accepted tokens,
+        fresh bucket / prefix keys / enqueue seq) and put it back on
+        the queue.  Shared by quarantine/failover replays and by
+        preemption parking — both resume through the same bit-exact
+        greedy path.  False = the grown prompt no longer fits any
+        bucket (shed, never parked at the queue front)."""
         replay = (np.concatenate([req.prompt,
                                   np.asarray(req.tokens, np.int32)])
                   if req.tokens else req.prompt)
         t = int(replay.shape[0])
         bucket = next((b for b in self.prompt_buckets if b >= t), None)
         if bucket is None:
-            self._shed(req, f"replay prompt {t} exceeds largest "
+            self._shed(req, f"{what} prompt {t} exceeds largest "
                        f"bucket {self.prompt_buckets[-1]}")
-            return
+            return False
         keys: tuple = ()
         if self.paged and self.prefix_cache_enabled:
             n_cacheable = (t - 1) // self.page_size
@@ -2680,14 +2870,89 @@ class ContinuousBatcher:
                 for i in range(n_cacheable))
         req.prefix_keys = keys
         req.admit_len = t
-        req.not_before_tick = self._step_count \
-            + self._backoff_ticks(req)
         padded = jnp.zeros((1, bucket), jnp.int32) \
             .at[0, :t].set(jnp.asarray(replay))
+        req.seq = self._seq
+        self._seq += 1
         self.queue.append((req, padded))
-        self.requests_retried += 1
+        return True
+
+    # -- low-priority decode preemption (ISSUE 13) ----------------------
+
+    def _preempt_slot(self, slot: int, req: _Request) -> None:
+        """Park a lower-priority DECODING request host-side so its
+        slot and pool pages serve a more critical admission: release
+        the pages, requeue prompt + accepted tokens.  The resume is
+        the engine's standing bit-exact greedy replay (the accepted
+        prefix conditions the identical continuation, prefix-cache
+        accelerated), so preemption is exactly-once and
+        token-identical to an unpreempted run.  Unlike quarantine it
+        consumes NO retry budget — being outranked is policy, not a
+        fault.  ``not_before_tick`` defers the resume one step so a
+        park can never bounce straight back into the slot it just
+        vacated ahead of the request it was preempted for."""
+        self.requests_preempted += 1
+        req.preemptions += 1
         if self._metrics is not None:
-            self._metrics.inc("serve_requests_retried")
+            self._metrics.inc("serve_requests_preempted")
+            self._metrics.inc("serve_requests_preempted"
+                              + f"_t{req.tier}")
+        if self._tracer is not None:
+            self._tracer.instant(
+                "request.preempt", self._req_spans.get(req.rid),
+                attrs={"rid": req.rid, "slot": slot, "tier": req.tier,
+                       "tokens": len(req.tokens)})
+        del self.slot_req[slot]
+        self._set_active(slot, False)
+        self._await_first.discard(slot)
+        self._release_pages(slot)
+        if self.spec_gamma:
+            self._accept_ema[slot] = 1.0
+            self._gcap[slot] = self.spec_gamma
+        req.resuming = True
+        req.not_before_tick = max(req.not_before_tick,
+                                  self._step_count + 1)
+        self._requeue_host(req, "parked")
+
+    def _maybe_preempt(self, req0: _Request, need_pages: int,
+                       need_slot: bool) -> list[int]:
+        """Free capacity for ``req0`` by preempting strictly
+        lower-priority decoding slots (lowest tier first, newest
+        first within a tier — the work discarded is the least
+        critical and the least sunk).  Victims must be greedy (a
+        sampled resume is not bit-exact), fully admitted (not
+        chunk-prefilling, not awaiting their first token — their
+        accounting has no in-flight remainder), not a migrate-out
+        leg, and REPLAYABLE: the grown prompt (prompt + accepted
+        tokens) must still fit the largest bucket, else parking
+        would silently convert a healthy request into a shed.
+        Returns the freed slot ids; empty when no eligible victim
+        exists or preempting ALL of them still could not fit the
+        ask (then nobody is parked in vain)."""
+        victims = sorted(
+            ((s, r) for s, r in self.slot_req.items()
+             if r.tier > req0.tier and not r.done
+             and s not in self._prefilling
+             and s not in self._await_first
+             and r.temperature == 0.0
+             and r.rid not in self._migrate_out
+             and int(r.prompt.shape[0]) + len(r.tokens)
+             <= self.prompt_buckets[-1]),
+            key=lambda sr: (-sr[1].tier, -sr[1].seq))
+        if not victims:
+            return []
+        if self.paged and need_pages > self._available_pages() + sum(
+                len(self._slot_pages.get(s, ())) for s, _ in victims):
+            return []
+        freed: list[int] = []
+        for s, r in victims:
+            fits = (not self.paged
+                    or need_pages <= self._available_pages())
+            if fits and (freed or not need_slot):
+                break
+            self._preempt_slot(s, r)
+            freed.append(s)
+        return freed
 
     def _quarantine(self, slot: int, req: _Request) -> None:
         """Invalid-logit self-defense: pull the offending slot out of
@@ -2750,15 +3015,45 @@ class ContinuousBatcher:
 
     def _expire_deadlines(self, finished: list) -> None:
         """Cancel requests whose per-request deadline passed; they
-        surface as FAILED in this step's return."""
+        surface as FAILED in this step's return.  Runs BEFORE
+        admission, so a QUEUED expiry is pruned without ever burning
+        prefill work — those count as ``deadline``-tagged sheds,
+        distinct from the pressure sheds (and from resident expiries,
+        which cancel mid-decode with their partial tokens).  Both the
+        wall-clock ``deadline_s`` and the deterministic
+        ``deadline_ticks`` cutoffs expire here."""
         reqs = [r for r, _ in self.queue] + list(self.slot_req.values())
-        if not any(r.deadline is not None for r in reqs):
+        if not any(r.deadline is not None or r.deadline_tick is not None
+                   for r in reqs):
             return
         now = time.monotonic()
-        for req in reqs:
-            if req.deadline is not None and now > req.deadline:
-                self._cancel_req(req, "deadline exceeded")
-                finished.append(req)
+
+        def _expired(r: _Request) -> bool:
+            return ((r.deadline is not None and now > r.deadline)
+                    or (r.deadline_tick is not None
+                        and self._step_count > r.deadline_tick))
+
+        for req, _ in [e for e in self.queue if _expired(e[0])]:
+            self._note_deadline_miss(req)
+            for i, (q, _) in enumerate(self.queue):
+                if q.rid == req.rid:
+                    del self.queue[i]
+                    break
+            # pruned pre-prefill: shed (reason-tagged), surfaced by
+            # this step's return via the _failed drain
+            self._shed(req, "deadline exceeded", reason="deadline")
+        for req in [r for r in self.slot_req.values() if _expired(r)]:
+            self._note_deadline_miss(req)
+            self._cancel_req(req, "deadline exceeded")
+            finished.append(req)
+
+    def _note_deadline_miss(self, req: _Request) -> None:
+        self.deadline_misses += 1
+        if self._metrics is not None:
+            self._metrics.inc("serve_deadline_miss")
+            if self._tier_mode:
+                self._metrics.inc("serve_deadline_miss"
+                                  + f"_t{req.tier}")
 
     def take_orphans(self) -> list[_Request]:
         """Requests that FINISHED in the very step() that killed this
@@ -3226,7 +3521,8 @@ class ContinuousBatcher:
         return out
 
     def import_chain(self, export: dict, max_new_tokens: int,
-                     temperature: float = 0.0) -> int | None:
+                     temperature: float = 0.0, tier: int = 0,
+                     tenant: str = "") -> int | None:
         """Adopt a migrated page chain: verify the digest, allocate
         pages, scatter the chain in, activate a slot mid-decode (the
         first generated token travels inside the export), and register
@@ -3278,8 +3574,14 @@ class ContinuousBatcher:
                        temperature=float(temperature),
                        prefix_keys=tuple(export["prefix_keys"]),
                        prompt=np.asarray(export["prompt"], np.int32),
-                       admit_len=t)
+                       admit_len=t, tier=int(tier),
+                       tenant=str(tenant))
         self._next_rid += 1
+        req.submit_tick = self._tick
+        req.seq = self._seq
+        self._seq += 1
+        if tier > 0:
+            self._tier_mode = True
         req.tokens = [int(export["first_token"])]
         pages = self._alloc_pages(need)
         self._slot_pages[slot] = pages
@@ -3724,6 +4026,8 @@ class _PoolEntry:
     local: int                    # engine-local rid on `replica`
     prefix: list = field(default_factory=list)   # accepted tokens
     retries: int = 0              # failover replays consumed
+    tier: int = 0                 # priority tier (survives failover)
+    tenant: str = ""              # quota bucket (survives failover)
 
 
 class DataParallelServePool:
@@ -3823,7 +4127,8 @@ class DataParallelServePool:
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
-               deadline_s: float | None = None) -> int:
+               deadline_s: float | None = None, tier: int = 0,
+               tenant: str = "") -> int:
         alive = self._alive()
         if not alive:
             raise ReplicaDeadError(
@@ -3832,7 +4137,8 @@ class DataParallelServePool:
                             for i, r in self.dead_replicas.items()))
         i = min(alive, key=self._route_key)
         local = self.replicas[i].submit(prompt, max_new_tokens,
-                                        temperature)
+                                        temperature, tier=tier,
+                                        tenant=tenant)
         rid = self._next_rid
         self._next_rid += 1
         self._entries[rid] = _PoolEntry(
@@ -3840,7 +4146,8 @@ class DataParallelServePool:
             max_new=max_new_tokens, temperature=float(temperature),
             deadline=(time.monotonic() + deadline_s
                       if deadline_s is not None else None),
-            replica=i, local=local)
+            replica=i, local=local, tier=int(tier),
+            tenant=str(tenant))
         self._local[(i, local)] = rid
         return rid
 
@@ -3912,7 +4219,8 @@ class DataParallelServePool:
         the disaggregated pool overrides with role awareness."""
         j = min(self._alive(), key=self._route_key)
         return j, self.replicas[j].submit(replay, remaining,
-                                          e.temperature)
+                                          e.temperature, tier=e.tier,
+                                          tenant=e.tenant)
 
     def _failover(self, i: int, reason: str, done: list) -> None:
         """Re-admit every request resident on dead replica ``i`` onto
@@ -4105,6 +4413,32 @@ class DataParallelServePool:
         return self.requests_retried + sum(
             e.requests_retried for e in self.replicas)
 
+    # SLO-guarded admission aggregates (ISSUE 13): the overload
+    # controls are per-engine; the pool sums them for the metric echo
+    @property
+    def requests_shed(self) -> int:
+        return sum(e.requests_shed for e in self.replicas)
+
+    @property
+    def requests_preempted(self) -> int:
+        return sum(e.requests_preempted for e in self.replicas)
+
+    @property
+    def requests_resumed(self) -> int:
+        return sum(e.requests_resumed for e in self.replicas)
+
+    @property
+    def deadline_misses(self) -> int:
+        return sum(e.deadline_misses for e in self.replicas)
+
+    @property
+    def shed_by_reason(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for e in self.replicas:
+            for k, v in e.shed_by_reason.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
     @property
     def spec_acceptance_rate(self) -> float:
         prop = sum(e.spec_drafts_proposed for e in self.replicas)
@@ -4198,7 +4532,8 @@ class DisaggServePool(DataParallelServePool):
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
-               deadline_s: float | None = None) -> int:
+               deadline_s: float | None = None, tier: int = 0,
+               tenant: str = "") -> int:
         alive = self._alive()
         if not alive:
             raise ReplicaDeadError(
@@ -4211,17 +4546,20 @@ class DisaggServePool(DataParallelServePool):
             # the disaggregated fast path: prefill leg emits ONE token
             i = min(pref, key=self._route_key)
             local = self.replicas[i].submit(
-                prompt, 1, temperature, migrate_out=True)
+                prompt, 1, temperature, migrate_out=True, tier=tier,
+                tenant=tenant)
         elif pref and max_new_tokens == 1:
             # satisfied entirely by prefill — no migration needed
             i = min(pref, key=self._route_key)
-            local = self.replicas[i].submit(prompt, 1, temperature)
+            local = self.replicas[i].submit(prompt, 1, temperature,
+                                            tier=tier, tenant=tenant)
         else:
             # degraded: one whole role is dead — serve symmetrically
             # on whatever survives
             i = min(alive, key=self._route_key)
             local = self.replicas[i].submit(prompt, max_new_tokens,
-                                            temperature)
+                                            temperature, tier=tier,
+                                            tenant=tenant)
         rid = self._next_rid
         self._next_rid += 1
         self._entries[rid] = _PoolEntry(
@@ -4229,7 +4567,8 @@ class DisaggServePool(DataParallelServePool):
             max_new=max_new_tokens, temperature=float(temperature),
             deadline=(time.monotonic() + deadline_s
                       if deadline_s is not None else None),
-            replica=i, local=local)
+            replica=i, local=local, tier=int(tier),
+            tenant=str(tenant))
         self._local[(i, local)] = rid
         return rid
 
@@ -4245,10 +4584,12 @@ class DisaggServePool(DataParallelServePool):
         if pref and dec and remaining > 1:
             j = min(pref, key=self._route_key)
             return j, self.replicas[j].submit(
-                replay, 1, e.temperature, migrate_out=True)
+                replay, 1, e.temperature, migrate_out=True,
+                tier=e.tier, tenant=e.tenant)
         j = min(alive, key=self._route_key)
         return j, self.replicas[j].submit(replay, remaining,
-                                          e.temperature)
+                                          e.temperature, tier=e.tier,
+                                          tenant=e.tenant)
 
     def _finish(self, replica: int, r: _Request, done: list) -> None:
         """A finisher from a PREFILL replica whose pool budget is not
@@ -4320,7 +4661,8 @@ class DisaggServePool(DataParallelServePool):
                            "to_replica": j})
             t0 = time.perf_counter()
             try:
-                local = eng.import_chain(exp, remaining, e.temperature)
+                local = eng.import_chain(exp, remaining, e.temperature,
+                                         tier=e.tier, tenant=e.tenant)
             except ReplicaDeadError:
                 self._pending_migrations.append((rid, exp))
                 if sp is not None:

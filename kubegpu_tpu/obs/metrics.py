@@ -38,6 +38,18 @@ name                            kind       meaning
 ``serving_spec_acceptance``     gauge      cluster-mean draft
                                            acceptance harvested from
                                            serve pods
+``serving_goodput_tokens_per_s``  gauge    pod-harvested goodput under
+                                           SLO, mirrored from
+                                           ``serve_goodput_tokens_per_s``
+                                           (ISSUE 13)
+``serving_slo_attainment``      gauge      pod-harvested SLO attainment
+                                           mirror (ISSUE 13)
+``serving_requests_shed``       gauge      pod-harvested shed-count
+                                           mirror (ISSUE 13)
+``serving_requests_preempted``  gauge      pod-harvested preemption
+                                           mirror (ISSUE 13)
+``serving_deadline_miss``       gauge      pod-harvested deadline-miss
+                                           mirror (ISSUE 13)
 ==============================  =========  ============================
 
 Serving engine (observed by ``ContinuousBatcher`` /
@@ -73,7 +85,11 @@ name                            kind       meaning
 ``serve_slots_quarantined``     counter    slots pulled on non-finite
                                            logits
 ``serve_requests_shed``         counter    admissions failed by
-                                           backpressure
+                                           backpressure; suffixed
+                                           ``_pressure`` / ``_quota`` /
+                                           ``_deadline`` per shed
+                                           reason and ``_t<k>`` per
+                                           tier (ISSUE 13)
 ``serve_dispatch_failures``     counter    transient dispatch failures
                                            retried in place
 ``serve_tick_stalls``           counter    watchdog deadline trips
@@ -115,7 +131,10 @@ name                            kind       meaning
                                            ``serve_queue_wait_ms``
                                            (schedule-pure; the CPU
                                            smoke A/B gates on it,
-                                           ISSUE 11)
+                                           ISSUE 11); suffixed
+                                           ``_t<k>`` per tier under
+                                           tiered admission
+                                           (ISSUE 13)
 ``serve_ttft_ticks``            histogram  submit → first token in
                                            engine service rounds — the
                                            deterministic twin of
@@ -126,6 +145,39 @@ name                            kind       meaning
                                            structural twin of
                                            ``serve_decode_stall_ms``
                                            (ISSUE 11)
+``serve_goodput_tokens_per_s``  gauge      tokens/s from requests that
+                                           met their tier's SLO — the
+                                           hardware (weather) claim of
+                                           goodput under overload
+                                           (ISSUE 13)
+``serve_goodput_tokens_per_tick``  gauge   goodput in tokens per engine
+                                           tick — the deterministic
+                                           twin the SLO smoke gates on
+                                           (ISSUE 13)
+``serve_slo_attainment``        gauge      fraction of offered requests
+                                           that met their tier's SLO;
+                                           suffixed ``_t<k>`` per tier
+                                           — the degradation story is
+                                           that ``_t0`` stays pinned
+                                           while lower tiers absorb
+                                           the overload (ISSUE 13)
+``serve_requests_preempted``    counter    low-priority decoding slots
+                                           parked host-side (pages
+                                           released) to serve a higher
+                                           tier; suffixed ``_t<k>`` by
+                                           the victim's tier
+                                           (ISSUE 13)
+``serve_requests_resumed``      counter    parked requests re-admitted
+                                           via the bit-exact greedy
+                                           replay path — converges to
+                                           the preempted counter at
+                                           drain (ISSUE 13)
+``serve_deadline_miss``         counter    requests expired by wall or
+                                           tick deadline (pre-prefill
+                                           prunes AND resident
+                                           cancels); suffixed
+                                           ``_t<k>`` per tier
+                                           (ISSUE 13)
 ==============================  =========  ============================
 
 Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as
@@ -135,6 +187,9 @@ Chrome/Perfetto JSON, not scraped): ``sched.schedule``, ``sched.bind``,
 ``request.admit``, ``request.prefill_chunk``, ``request.replay``,
 ``request.migrate`` (attrs: ``rid``, ``pages``, ``to_replica``,
 ``outcome``, ``ms`` — the prefill→decode page-chain hand-off),
+``request.preempt`` / ``request.resume`` (attrs: ``rid``, ``slot``,
+``tier``, ``preemptions`` — the park/replay handshake of low-priority
+preemption, ISSUE 13),
 ``request.quarantine``, ``pool.failover``, ``engine.tick``,
 ``engine.dispatch``, ``engine.verify``, ``engine.collect``,
 ``engine.admit``, plus ``sched.<kind>`` instants forwarded from

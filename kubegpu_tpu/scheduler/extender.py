@@ -199,13 +199,25 @@ class DeviceScheduler:
         # HBM accounting rides the same harvest (ISSUE 10): live/peak
         # pool bytes per pod, mirrored so capacity planning reads the
         # engine's real donation-era footprint off the scrape surface
+        # overload signals ride it too (ISSUE 13): goodput-under-SLO
+        # and shed/preempt/deadline pressure per pod — the scheduler
+        # finally consumes load, so placement can react to a slice
+        # that is shedding its paying tiers rather than just to one
+        # that is dying
         for src, dst in (
                 ("serve_failover_total", "serving_failover_total"),
                 ("serve_requests_retried", "serving_requests_retried"),
                 ("serve_slots_quarantined",
                  "serving_slots_quarantined"),
                 ("serve_hbm_pool_bytes", "serving_hbm_pool_bytes"),
-                ("serve_hbm_peak_bytes", "serving_hbm_peak_bytes")):
+                ("serve_hbm_peak_bytes", "serving_hbm_peak_bytes"),
+                ("serve_goodput_tokens_per_s",
+                 "serving_goodput_tokens_per_s"),
+                ("serve_slo_attainment", "serving_slo_attainment"),
+                ("serve_requests_shed", "serving_requests_shed"),
+                ("serve_requests_preempted",
+                 "serving_requests_preempted"),
+                ("serve_deadline_miss", "serving_deadline_miss")):
             v = out.get(src)
             if v is not None:
                 self.metrics.set_gauge(dst, v)

@@ -394,7 +394,21 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                 # donation this sits at ~1× the pool; ~2× means
                 # donation silently stopped aliasing on this build
                 ("serve_hbm_pool_bytes", eng.hbm_pool_bytes),
-                ("serve_hbm_peak_bytes", eng.hbm_peak_bytes)):
+                ("serve_hbm_peak_bytes", eng.hbm_peak_bytes),
+                # overload echo (ISSUE 13): zeros on an unloaded run,
+                # harvested unconditionally so serving_metrics() can
+                # mirror the shed/preempt/deadline pressure per pod;
+                # with no tiers configured every request is
+                # best-effort, so goodput-under-SLO degenerates to
+                # the raw tokens/s above
+                ("serve_goodput_tokens_per_s",
+                 round(total / elapsed, 1)),
+                ("serve_requests_preempted",
+                 getattr(eng, "requests_preempted", 0)),
+                ("serve_requests_resumed",
+                 getattr(eng, "requests_resumed", 0)),
+                ("serve_deadline_miss",
+                 getattr(eng, "deadline_misses", 0))):
             print(json.dumps({"metric": name, "value": value}))
         if tracer is not None:
             # trace echo: span count is harvestable; the full Perfetto

@@ -202,6 +202,16 @@ class TestSummary:
                             "stall_p99_reduction_x": 12.35},
                         "cb_equal_hbm": {
                             "paged_vs_dense_equal_hbm": 1.31},
+                        "cb_slo_goodput": {
+                            "top_tier_goodput_ratio_x": 5.846,
+                            "fifo": {
+                                "goodput_tokens_per_tick": 3.02,
+                                "slo_attainment": 0.78,
+                                "ttft_p99_ms": 159.3},
+                            "tiered": {
+                                "goodput_tokens_per_tick": 4.14,
+                                "slo_attainment": 1.0,
+                                "ttft_p99_ms": 128.9}},
                         "spec_decode": {"speedup_vs_greedy": 1.62,
                                         "acceptance_rate": 0.84},
                         "spec_decode_pld": {
@@ -263,6 +273,15 @@ class TestSummary:
         assert s["multislice"]["frac"] == 0.16
         assert s["multislice"]["p99_top"] == "multislice_split"
         assert s["serve_pod"]["vs_lib"] == 0.91
+        # goodput/SLO columns ride next to the tail columns for every
+        # serving row that measured them (ISSUE 13) — sparse, so rows
+        # without a load-harness run don't burn the byte budget
+        assert s["serving_goodput"]["cb_slo_goodput"]["tiered"] == \
+            [4.14, 1.0]
+        assert s["serving_goodput"]["cb_slo_goodput"]["fifo"] == \
+            [3.02, 0.78]
+        assert "cb_prefix_cache" not in s["serving_goodput"]
+        assert "cb_slo_goodput" in s["serving_tails"]
         assert "mfu" in line  # the driver's done-bar grep
 
     def test_summary_survives_errors_and_absence(self):

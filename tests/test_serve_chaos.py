@@ -510,3 +510,198 @@ class TestFusedChaos:
         for rid, (p, n) in rids.items():
             assert seen[rid].error is None, (rid, seen[rid].error)
             assert seen[rid].tokens == solo(params, p, n, cfg), rid
+
+
+class TestOverloadAdmission:
+    """SLO-guarded overload (ISSUE 13): tiered admission, deadline
+    pruning, tenant quotas, and low-priority preemption composed with
+    the chaos matrix.  Parking is host-side bookkeeping (pages
+    released, request requeued), so every fault the engine already
+    survives must compose with it — and the strict-across-tiers
+    ordering must hold under arbitrary seeded overload."""
+
+    def _eng(self, params, cfg, **kw):
+        kw.setdefault("n_slots", 2)
+        kw.setdefault("stride", 2)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("paged", True)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("total_pages", 12)
+        return ContinuousBatcher(params, cfg, **kw)
+
+    def test_preempt_then_replica_kill_exactly_once_bit_exact(self, tiny):
+        """THE composition the issue demands: low-priority requests
+        preempted mid-decode to make room for a higher tier, then a
+        replica killed while the victims sit parked host-side — after
+        failover every request (victims included) still completes
+        exactly once with tokens bit-exact vs the solo run."""
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        reg = MetricsRegistry()
+        pool = DataParallelServePool(
+            params, cfg, dp=2, tp=1, n_slots=2, stride=2,
+            prompt_buckets=(8, 16), paged=True, page_size=8,
+            total_pages=12, metrics=reg,
+            chaos={0: ChaosInjector(
+                [ChaosEvent(tick=5, kind="kill_replica")])})
+        low = [([(i * 3 + j) % cfg.vocab_size for i in range(4 + j)],
+                8) for j in range(4)]
+        rids = {pool.submit(p, n, tier=2): (p, n) for p, n in low}
+        for _ in range(3):          # victims reach mid-decode
+            pool.step()
+        hi = [([(i * 5 + 7) % cfg.vocab_size for i in range(5)], 6),
+              ([(i * 7 + 3) % cfg.vocab_size for i in range(6)], 6)]
+        rids.update({pool.submit(p, n, tier=0): (p, n)
+                     for p, n in hi})
+        seen = {}
+        for r in pool.drain():
+            assert r.rid not in seen, f"rid {r.rid} completed twice"
+            seen[r.rid] = r
+        assert set(seen) == set(rids), "request lost"
+        assert pool.failovers == 1
+        assert 0 in pool.dead_replicas
+        assert pool.requests_preempted >= 1, \
+            "scenario never exercised preemption"
+        assert reg.counter("serve_requests_preempted") >= 1
+        for rid, (p, n) in rids.items():
+            assert seen[rid].error is None, (rid, seen[rid].error)
+            assert seen[rid].tokens == solo(params, p, n, cfg), rid
+
+    def test_preempt_resume_composes_with_nan_quarantine(self, tiny):
+        """Engine-level composition: a parked victim resumed through
+        greedy replay onto a slot that then takes a NaN poisoning —
+        the quarantine replay path and the preemption replay path
+        share bookkeeping, and the request must still surface once,
+        bit-exact."""
+        cfg, params = tiny
+        reg = MetricsRegistry()
+        eng = self._eng(params, cfg, metrics=reg,
+                        chaos=ChaosInjector(
+                            [ChaosEvent(tick=5, kind="nan_logits")]))
+        low = [([(i * 3 + j) % cfg.vocab_size for i in range(4 + j)],
+                8) for j in range(2)]
+        rids = {eng.submit(p, n, tier=2): (p, n) for p, n in low}
+        for _ in range(3):
+            eng.step()
+        p_hi = [(i * 5 + 7) % cfg.vocab_size for i in range(5)]
+        rids[eng.submit(p_hi, 6, tier=0)] = (p_hi, 6)
+        seen = {}
+        for r in eng.drain():
+            assert r.rid not in seen, f"rid {r.rid} completed twice"
+            seen[r.rid] = r
+        assert set(seen) == set(rids), "request lost"
+        assert eng.requests_preempted >= 1
+        assert eng.requests_resumed == eng.requests_preempted
+        assert eng.slots_quarantined >= 1, \
+            "chaos tick never landed on a live slot"
+        for rid, (p, n) in rids.items():
+            assert seen[rid].error is None, (rid, seen[rid].error)
+            assert seen[rid].tokens == solo(params, p, n, cfg), rid
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_tier_ordering_never_inverted_under_overload(self, tiny,
+                                                         seed):
+        """Property: under seeded bursty overload, the engine never
+        admits a lower-priority request while a higher-priority one
+        sits eligible in the queue — checked tick by tick against the
+        live queue, not inferred from aggregate timings."""
+        cfg, params = tiny
+        from kubegpu_tpu.loadgen import LoadSpec, TierSpec, synth_trace
+        tiers = tuple(TierSpec(f"t{k}", 10 ** 6, 10 ** 6.0, s)
+                      for k, s in enumerate((0.3, 0.4, 0.3)))
+        spec = LoadSpec(seed=seed, n_requests=24, mean_iat_ticks=0.7,
+                        burst=True, prompt_len_max=8, out_len_min=2,
+                        out_len_max=8, vocab=min(48, cfg.vocab_size),
+                        tiers=tiers)
+        trace = synth_trace(spec)
+        reg = MetricsRegistry()
+        eng = self._eng(params, cfg, metrics=reg)
+        done: dict[int, object] = {}
+        i = 0
+        max_queue = 0
+        for tick in range(600):
+            while i < len(trace) and trace[i]["arrival_tick"] <= tick:
+                item = trace[i]
+                eng.submit(item["prompt"], item["max_new"],
+                           tier=item["tier"])
+                i += 1
+            max_queue = max(max_queue, len(eng.queue))
+            eligible = {r.rid: r.tier for r, _ in eng.queue
+                        if r.not_before_tick <= eng._step_count}
+            for r in eng.step():
+                assert r.rid not in done, "duplicate completion"
+                done[r.rid] = r
+            still = {r.rid for r, _ in eng.queue}
+            admitted = [t for rid, t in eligible.items()
+                        if rid not in still]
+            waiting = [t for rid, t in eligible.items() if rid in still]
+            if admitted and waiting:
+                assert max(admitted) <= min(waiting), \
+                    (seed, tick, admitted, waiting)
+            if i >= len(trace) and not eng.queue and not eng.slot_req:
+                break
+        assert len(done) == len(trace), "run did not drain"
+        assert max_queue >= 3, "scenario never actually overloaded"
+        assert all(r.error is None for r in done.values())
+
+    def test_deadline_pruned_pre_prefill_lowest_tier_starves_first(
+            self, tiny):
+        """Satellite (a): a queued low-tier request whose tick deadline
+        lapses is pruned BEFORE any prefill work (no tokens, separate
+        ``deadline`` shed reason), while a later-submitted tier-0
+        request overtakes it and completes — shed lowest tier first,
+        never miss a higher tier's SLO to serve a lower one."""
+        cfg, params = tiny
+        reg = MetricsRegistry()
+        eng = self._eng(params, cfg, n_slots=1, metrics=reg)
+        p_a = [(i * 3 + 1) % cfg.vocab_size for i in range(5)]
+        p_b = [(i * 5 + 2) % cfg.vocab_size for i in range(6)]
+        p_c = [(i * 7 + 3) % cfg.vocab_size for i in range(7)]
+        ra = eng.submit(p_a, 8, tier=0)             # occupies the slot
+        rb = eng.submit(p_b, 6, tier=2, deadline_ticks=4)
+        rc = eng.submit(p_c, 6, tier=0)
+        done = {r.rid: r for r in eng.drain()}
+        assert set(done) == {ra, rb, rc}
+        assert done[rb].error == "deadline exceeded"
+        assert done[rb].tokens == [], \
+            "pruned request burned prefill work"
+        for rid, (p, n) in ((ra, (p_a, 8)), (rc, (p_c, 6))):
+            assert done[rid].error is None
+            assert done[rid].tokens == solo(params, p, n, cfg)
+        assert eng.shed_by_reason == {"deadline": 1}
+        assert eng.deadline_misses == 1
+        assert reg.counter("serve_requests_shed") == 1
+        assert reg.counter("serve_requests_shed_deadline") == 1
+        assert reg.counter("serve_requests_shed_t2") == 1
+        assert reg.counter("serve_deadline_miss") == 1
+        assert reg.counter("serve_deadline_miss_t2") == 1
+
+    def test_tenant_quota_sheds_at_door_and_frees_on_finish(self, tiny):
+        """Per-tenant quotas bound IN-FLIGHT work: the over-quota
+        submit is rejected before queueing (reason ``quota``), other
+        tenants are untouched, and finishing a request frees the
+        tenant's slot for a later submit."""
+        cfg, params = tiny
+        reg = MetricsRegistry()
+        eng = self._eng(params, cfg, tenant_quotas={"acme": 1},
+                        metrics=reg)
+        p1 = [1, 2, 3]
+        p2 = [4, 5, 6]
+        p3 = [7, 8, 9]
+        r1 = eng.submit(p1, 5, tenant="acme")
+        r2 = eng.submit(p2, 5, tenant="acme")     # over quota: shed
+        r3 = eng.submit(p3, 5, tenant="other")
+        done = {r.rid: r for r in eng.drain()}
+        assert set(done) == {r1, r2, r3}
+        assert "quota" in done[r2].error
+        assert done[r2].tokens == []
+        assert done[r1].tokens == solo(params, p1, 5, cfg)
+        assert done[r3].tokens == solo(params, p3, 5, cfg)
+        assert eng.shed_by_reason == {"quota": 1}
+        assert reg.counter("serve_requests_shed_quota") == 1
+        # the quota slot freed with r1 — the tenant can submit again
+        r4 = eng.submit(p2, 5, tenant="acme")
+        done2 = {r.rid: r for r in eng.drain()}
+        assert done2[r4].error is None
+        assert done2[r4].tokens == solo(params, p2, 5, cfg)
