@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
-        fused-smoke hbm-smoke disagg-smoke slo-smoke analyze clean
+        fused-smoke hbm-smoke disagg-smoke slo-smoke route-smoke \
+        analyze clean
 
 all: native
 
@@ -114,6 +115,30 @@ slo-smoke: analyze              # ISSUE 13 overload robustness: the
 		assert r['lost'] == 0 and r['duplicated'] == 0, r; \
 		assert r['top_tier_goodput_ratio_x'] >= 1.3, r; \
 		assert r['tiered']['top_tier']['attainment'] >= 0.9, r"
+
+route-smoke: analyze            # ISSUE 14 closing the loop: routing
+	# determinism + affinity-pull + drain/scale unit tests, then the
+	# affinity-vs-least-loaded A/B (>= 1.3x top-tier goodput-under-SLO
+	# at equal chips, bit-exact tokens, zero lost/duplicated) and one
+	# full scale-up -> scale-down cycle through the extender gang path
+	# (drain via replay parking, exactly-once asserted).
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_routing_autoscale.py -q
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -c "import json; \
+		from kubegpu_tpu.benchmark import run_serving_bench_smoke; \
+		row = run_serving_bench_smoke( \
+			legs=['cb_prefix_affinity', 'cb_autoscale']); \
+		print(json.dumps(row, indent=1)); \
+		r = row['cb_prefix_affinity']; \
+		assert r['bit_exact'], 'routing changed tokens'; \
+		assert r['lost'] == 0 and r['duplicated'] == 0, r; \
+		assert r['top_tier_goodput_ratio_x'] >= 1.3, r; \
+		a = row['cb_autoscale']; \
+		assert a['scale_ups'] >= 1 and a['scale_downs'] >= 1, a; \
+		assert a['drain_replays'] >= 1, a; \
+		assert a['exactly_once'] and a['bit_exact'], a"
 
 trace-smoke:                    # ISSUE 6 observability: a traced serve
 	# window must yield ONE connected span tree from extender bind

@@ -2231,6 +2231,279 @@ def _cb_slo_goodput_bench(params, cfg) -> dict:
     }
 
 
+def _cb_prefix_affinity_bench(params, cfg) -> dict:
+    """Prefix-affinity routing A/B (ISSUE 14 tentpole, routing half):
+    the SAME seeded bursty shared-prefix trace through a
+    ``DataParallelServePool(dp=2)`` twice at EQUAL chips — once with
+    ``routing="affinity"`` (each replica's chain-hash digest scores
+    placement: resident pages of this prompt's chain minus the
+    least-loaded penalty), once with pure least-loaded.  Affinity
+    keeps each shared prefix on ONE replica, so its requests alias
+    the registry pages instead of re-prefilling the chain on whichever
+    replica happened to be emptiest — fewer prefill chunks before the
+    first token AND fewer pages claimed per admit under a tight pool.
+    The gate is tick-pure: the affinity leg's TOP-TIER
+    goodput-under-SLO must be >= 1.3x the least-loaded leg's, with
+    BIT-EXACT tokens against an unloaded reference (routing never
+    touches a device buffer — the digest is host arithmetic riding
+    the metric-echo path) and zero lost/duplicated requests.  Wall
+    clocks ride along as weather."""
+    import jax
+
+    from kubegpu_tpu.loadgen import (
+        LoadSpec,
+        TierSpec,
+        run_load,
+        synth_trace,
+    )
+    from kubegpu_tpu.models.serve import (
+        ContinuousBatcher,
+        DataParallelServePool,
+    )
+    from kubegpu_tpu.obs.metrics import MetricsRegistry
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs 2 devices"}
+
+    TIERS = (TierSpec("gold", ttft_slo_ticks=8, token_slo_ticks=4.0,
+                      share=0.4),
+             TierSpec("std", ttft_slo_ticks=40, token_slo_ticks=8.0,
+                      share=0.3),
+             TierSpec("batch", ttft_slo_ticks=10 ** 6,
+                      token_slo_ticks=10 ** 6, share=0.3))
+    # long prompts dominated by 3-page (24-token) shared prefixes and
+    # SHORT decodes — prefill is the workload, so a chain hit (admit
+    # at chunk 3 of 4 instead of chunk 0, alias 3 pages instead of
+    # allocating them) is most of a request's cost.  THREE prefixes
+    # against a pool that holds at most two chains per replica is the
+    # interference the router exists for: least-loaded interleaves all
+    # three chains onto both replicas and the registries thrash, while
+    # affinity parks each chain on one home replica where residents
+    # keep re-referencing it.  (One affinity page only TIES against an
+    # idle replica — the load penalty of one queued request cancels it
+    # — so short-prefix traffic would show nothing.)
+    spec = LoadSpec(seed=7, n_requests=48, mean_iat_ticks=0.5,
+                    burst=True, prompt_len_mean=3.4,
+                    prompt_len_sigma=0.1, prompt_len_max=32,
+                    out_len_min=2, out_len_max=6, prefix_share=0.95,
+                    n_shared_prefixes=3, prefix_len=24,
+                    vocab=min(48, cfg.vocab_size), tiers=TIERS)
+    trace = synth_trace(spec)
+    pool_kw = dict(n_slots=2, stride=2, prompt_buckets=(32,),
+                   paged=True, page_size=8, total_pages=11,
+                   prefix_cache=True, chunked_prefill=True,
+                   prefill_chunk=8)
+    TAILS = {"ttft_p99_ms": "serve_ttft_ms",
+             "queue_wait_p99_ms": "serve_queue_wait_ms",
+             "ttft_p99_ticks": "serve_ttft_ticks",
+             "queue_wait_p99_ticks": "serve_queue_wait_ticks"}
+
+    def leg(routing):
+        reg = MetricsRegistry()
+        pool = DataParallelServePool(params, cfg, dp=2, tp=1,
+                                     metrics=reg, routing=routing,
+                                     **pool_kw)
+        pool.warmup()   # compile outside the measured window
+        rep = run_load(pool, trace, TIERS, metrics=reg)
+        hists = reg.snapshot()["histograms"]
+        tails = {k: (round(hists[m]["p99"], 3) if m in hists
+                     else None)
+                 for k, m in TAILS.items()}
+        return pool, rep, tails
+
+    ll_pool, ll, ll_tails = leg("least_loaded")
+    af_pool, aff, af_tails = leg("affinity")
+
+    # unloaded reference: every unique (prompt, budget) alone on a
+    # fresh engine — placement must never change a token
+    ref_eng = ContinuousBatcher(params, cfg, **pool_kw)
+    ref: dict = {}
+    for item in trace:
+        key = (item["prompt"].tobytes(), item["max_new"])
+        if key in ref:
+            continue
+        rid = ref_eng.submit(item["prompt"], item["max_new"])
+        ref[key] = {r.rid: list(r.tokens)
+                    for r in ref_eng.drain()}[rid]
+    bit_exact = all(
+        rec["tokens"] == ref[(rec["prompt"].tobytes(),
+                              rec["max_new"])]
+        for rep_ in (ll, aff) for rec in rep_.records
+        if rec["completed"])
+
+    def leg_dict(pool, rep, tails):
+        return {
+            "goodput_tokens_per_tick":
+                round(rep.goodput_tokens_per_tick, 4),
+            "slo_attainment": round(rep.slo_attainment, 4),
+            "top_tier": {
+                "attainment": rep.per_tier[0]["attainment"],
+                "goodput_tokens": rep.per_tier[0]["goodput_tokens"],
+            },
+            "per_tier_attainment": [rep.per_tier[k]["attainment"]
+                                    for k in range(len(TIERS))],
+            "ticks": rep.ticks,
+            "completed": rep.completed, "failed": rep.failed,
+            "affinity_hits": pool.routing_affinity_hits,
+            "affinity_hit_rate":
+                round(pool.routing_affinity_hit_rate, 4),
+            **tails,
+            "wall_ms_raw_weather": round(rep.wall_s * 1e3, 1),
+        }
+
+    ll_top = ll.per_tier[0]["goodput_tokens"] / max(ll.ticks, 1)
+    af_top = aff.per_tier[0]["goodput_tokens"] / max(aff.ticks, 1)
+    return {
+        "protocol": "same_trace_equal_chip_ab",
+        "chips_per_leg": 2,
+        "requests": len(trace),
+        "shared_prefix_pages": spec.prefix_len // 8,
+        "least_loaded": leg_dict(ll_pool, ll, ll_tails),
+        "affinity": leg_dict(af_pool, aff, af_tails),
+        # deterministic (tick-denominated) gate: chain-aware placement
+        # must buy the top tier >= 1.3x goodput-under-SLO at equal chips
+        "top_tier_goodput_ratio_x":
+            round(af_top / ll_top, 3) if ll_top else None,
+        "routing_affinity_hit_rate":
+            round(af_pool.routing_affinity_hit_rate, 4),
+        "bit_exact": bit_exact,
+        "lost": ll.lost + aff.lost,
+        "duplicated": ll.duplicated + aff.duplicated,
+    }
+
+
+def _cb_autoscale_bench(params, cfg) -> dict:
+    """SLO-driven autoscaling through the control plane (ISSUE 14
+    tentpole, scaling half): one seeded burst-then-trickle trace
+    drives a ``DataParallelServePool`` whose ``run_load`` controller
+    is a :class:`ServingAutoscaler` bound to a live ``SimCluster``.
+    The burst pushes queue wait over the watermark → the policy holds,
+    then scales UP through the extender gang path
+    (``spawn_serving_gang`` → ``add_replica(gang=...)``); the trickle
+    tail calms the signals → the policy scales DOWN
+    (``retire_replica`` → drain via the bit-exact replay parking →
+    ``evict_gang(requeue=False)``, whose watch-delivered death the
+    pool sees as already-drained).  Gates: at least one up AND one
+    down event, replicas max > min, exactly-once completion (zero
+    lost/duplicated), BIT-EXACT tokens vs an unloaded reference, and
+    the compile census unchanged (asserted by the census leg — the
+    whole loop is host-side)."""
+    import jax
+
+    from kubegpu_tpu.cluster import SimCluster
+    from kubegpu_tpu.loadgen import (
+        LoadSpec,
+        TierSpec,
+        run_load,
+        synth_trace,
+    )
+    from kubegpu_tpu.models.serve import (
+        ContinuousBatcher,
+        DataParallelServePool,
+    )
+    from kubegpu_tpu.obs.metrics import MetricsRegistry
+    from kubegpu_tpu.scheduler.serve import (
+        AutoscaleConfig,
+        AutoscalePolicy,
+        ServingAutoscaler,
+    )
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs 2 devices"}
+
+    TIERS = (TierSpec("std", ttft_slo_ticks=20,
+                      token_slo_ticks=8.0),)
+    vocab = min(48, cfg.vocab_size)
+    # burst head (tight arrivals pile the queue) + trickle tail (light
+    # traffic keeps flowing while the pool calms back down, so the
+    # scale-down drain happens mid-traffic, not on an idle pool).  The
+    # tail shares ONE 1-page prefix: affinity homes its chain on the
+    # scaled-up replica — the emptiest when the first tail request
+    # lands — so the highest-index victim the autoscaler retires still
+    # holds trickle residents, and the drain's replay parking is
+    # exercised for real, not vacuously on an empty engine.
+    head = synth_trace(LoadSpec(
+        seed=5, n_requests=20, mean_iat_ticks=0.4, burst=True,
+        prompt_len_max=8, out_len_min=2, out_len_max=8, vocab=vocab,
+        tiers=TIERS))
+    tail = synth_trace(LoadSpec(
+        seed=6, n_requests=12, mean_iat_ticks=3.0,
+        prompt_len_mean=2.4, prompt_len_sigma=0.2, prompt_len_max=16,
+        prefix_share=0.95, n_shared_prefixes=1, prefix_len=8,
+        out_len_min=4, out_len_max=8, vocab=vocab, tiers=TIERS))
+    shift = max(e["arrival_tick"] for e in head) + 4
+    for e in tail:
+        e["arrival_tick"] += shift
+    trace = head + tail
+    eng_kw = dict(n_slots=2, stride=2, prompt_buckets=(8, 16),
+                  paged=True, page_size=8, total_pages=8,
+                  prefix_cache=True)
+
+    reg = MetricsRegistry()
+    cl = SimCluster(["v5e-16"])
+    try:
+        # the base replica's gang goes through the SAME extender path
+        # the autoscaler uses, so the health watch covers both alike
+        cl.scheduler.spawn_serving_gang("serve-base", chips=1)
+        pool = DataParallelServePool(
+            params, cfg, dp=1, tp=1, devices=jax.devices(),
+            metrics=reg, **eng_kw)
+        pool.warmup()
+        pool.bind_replica_gang(0, "serve-base")
+        pool.watch_health(cl.api)
+        policy = AutoscalePolicy(AutoscaleConfig(
+            min_replicas=1, max_replicas=2,
+            queue_wait_high_ticks=3.0, attainment_low=0.5,
+            hold_ticks=2, idle_ticks=6, cooldown_ticks=8))
+        scaler = ServingAutoscaler(pool, policy,
+                                   scheduler=cl.scheduler,
+                                   cluster=cl, chips_per_replica=1)
+        rep = run_load(pool, trace, TIERS, metrics=reg,
+                       controller=scaler)
+    finally:
+        cl.close()
+
+    # unloaded reference: placement AND scaling must never change a
+    # token — drained residents replay bit-exactly on survivors
+    ref_eng = ContinuousBatcher(params, cfg, **eng_kw)
+    ref: dict = {}
+    for item in trace:
+        key = (item["prompt"].tobytes(), item["max_new"])
+        if key in ref:
+            continue
+        rid = ref_eng.submit(item["prompt"], item["max_new"])
+        ref[key] = {r.rid: list(r.tokens)
+                    for r in ref_eng.drain()}[rid]
+    bit_exact = all(
+        rec["tokens"] == ref[(rec["prompt"].tobytes(),
+                              rec["max_new"])]
+        for rec in rep.records if rec["completed"])
+
+    return {
+        "protocol": "closed_loop_autoscale",
+        "requests": len(trace),
+        "ticks": rep.ticks,
+        "completed": rep.completed, "failed": rep.failed,
+        "scale_ups": scaler.scale_ups,
+        "scale_downs": scaler.scale_downs,
+        "events": [[t, d, r] for t, d, r in scaler.events],
+        "decisions": [[t, a] for t, a in policy.decisions],
+        "replicas_min": pool.replicas_active_min,
+        "replicas_max": pool.replicas_active_max,
+        "autoscale_events": pool.autoscale_events,
+        "drains": pool.drains,
+        "drain_replays": pool.drain_replays,
+        "failovers": pool.failovers,
+        "exactly_once": rep.lost == 0 and rep.duplicated == 0,
+        "lost": rep.lost, "duplicated": rep.duplicated,
+        "bit_exact": bit_exact,
+        "goodput_tokens_per_tick":
+            round(rep.goodput_tokens_per_tick, 4),
+        "slo_attainment": round(rep.slo_attainment, 4),
+        "wall_ms_raw_weather": round(rep.wall_s * 1e3, 1),
+    }
+
+
 def run_serving_bench_smoke(legs=None) -> dict:
     """Tiny-config run of ONLY the serving fast-path bench legs
     (prefix cache, chunked-prefill stall, equal-HBM mixed-length A/B,
@@ -2300,6 +2573,9 @@ def run_serving_bench_smoke(legs=None) -> dict:
             params, cfg, slots=2, prompt=16, new=24, stride=2, page=8,
             chunk=8, reqs=8),
         "cb_slo_goodput": lambda: _cb_slo_goodput_bench(params, cfg),
+        "cb_prefix_affinity": lambda: _cb_prefix_affinity_bench(
+            params, cfg),
+        "cb_autoscale": lambda: _cb_autoscale_bench(params, cfg),
         "cb_compile_census": _cb_compile_census_bench,
     }
     if legs is not None:
@@ -2915,6 +3191,29 @@ def summarize_bench(out: dict) -> dict:
             and (cols := _goodput_cols(row)) is not None}
         if goodput:
             s["serving_goodput"] = goodput
+        # routing / autoscale columns (ISSUE 14 sat.) — sparse like
+        # the goodput table: [affinity hit-rate, replicas min→max]
+        # for rows that routed traffic through the pool or scaled it
+
+        def _routing_cols(row):
+            hit = row.get("routing_affinity_hit_rate")
+            if hit is None and isinstance(row.get("affinity"), dict):
+                hit = row["affinity"].get("affinity_hit_rate")
+            lo, hi = row.get("replicas_min"), row.get("replicas_max")
+            if hit is None and lo is None:
+                return None
+            return [hit, f"{lo}→{hi}" if lo is not None else None]
+
+        routing = {
+            name: cols
+            for name, row in list(fam.items()) + [("serving", sv)]
+            if isinstance(row, dict) and "skipped" not in row
+            and "error" not in row
+            and (name == "serving" or name.startswith(
+                ("cb", "continuous_batching")))
+            and (cols := _routing_cols(row)) is not None}
+        if routing:
+            s["serving_routing"] = routing
     elif isinstance(m, dict):
         s["model"] = {"error": str(m["error"])[:120]}
 

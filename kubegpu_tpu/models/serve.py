@@ -1337,6 +1337,57 @@ def _trim_acct(xs: list) -> None:
         del xs[:len(xs) - _ACCT_CAP // 2]
 
 
+class _AdmissionQueue(deque):
+    """The engine's admission queue with an INCREMENTAL queued-prompt-
+    token total: every mutation the engine performs (append at submit,
+    popleft at admission, ``del q[i]`` at cancel/deadline-prune, the
+    sorted rebuild in ``_sort_queue``) keeps :attr:`prompt_tokens`
+    equal to ``sum(r.prompt_len for r, _ in q)``, so the pool router's
+    prefill-backlog tiebreak reads one attribute instead of scanning
+    arbitrarily deep queues per submit — routing stays O(replicas).
+    Items are the engine's ``(request, padded_prompt)`` pairs."""
+
+    def __init__(self, items=()):
+        super().__init__()
+        self.prompt_tokens = 0
+        for item in items:
+            self.append(item)
+
+    def append(self, item) -> None:
+        super().append(item)
+        self.prompt_tokens += item[0].prompt_len
+
+    def appendleft(self, item) -> None:
+        super().appendleft(item)
+        self.prompt_tokens += item[0].prompt_len
+
+    def extend(self, items) -> None:
+        for item in items:
+            self.append(item)
+
+    def popleft(self):
+        item = super().popleft()
+        self.prompt_tokens -= item[0].prompt_len
+        return item
+
+    def pop(self):
+        item = super().pop()
+        self.prompt_tokens -= item[0].prompt_len
+        return item
+
+    def remove(self, item) -> None:
+        super().remove(item)
+        self.prompt_tokens -= item[0].prompt_len
+
+    def clear(self) -> None:
+        super().clear()
+        self.prompt_tokens = 0
+
+    def __delitem__(self, i) -> None:
+        self.prompt_tokens -= self[i][0].prompt_len
+        super().__delitem__(i)
+
+
 def _chain_digest(chain: dict, t: int) -> str:
     """Content hash of an exported page chain (every leaf — int8
     values AND scales — plus the prompt length).  The importing engine
@@ -1782,7 +1833,7 @@ class ContinuousBatcher:
         from kubegpu_tpu.obs.metrics import LiveBytesTracker
         self.hbm = LiveBytesTracker(metrics)
         self.slot_req: dict[int, _Request] = {}
-        self.queue: deque[tuple[_Request, jax.Array]] = deque()
+        self.queue: _AdmissionQueue = _AdmissionQueue()
         self._inflight: jax.Array | None = None   # fused (block, firsts)
         self._next_rid = 0
         # generated-token bookkeeping (totals; the bench's numerator)
@@ -2359,7 +2410,7 @@ class ContinuousBatcher:
         ``deadline_s`` never participates: wall time is weather and
         must not drive the schedule the deterministic twins gate."""
         if len(self.queue) > 1:
-            self.queue = deque(sorted(
+            self.queue = _AdmissionQueue(sorted(
                 self.queue,
                 key=lambda e: (e[0].tier,
                                e[0].deadline_tick
@@ -4067,16 +4118,30 @@ class DataParallelServePool:
 
     def __init__(self, params: dict, cfg, dp: int = 1, tp: int = 1,
                  devices=None, metrics=None, max_replays: int = 2,
-                 chaos=None, tracer=None, trace_ctx=None, **engine_kw):
+                 chaos=None, tracer=None, trace_ctx=None,
+                 routing: str = "affinity", **engine_kw):
         devs = list(devices if devices is not None
                     else jax.devices()[:dp * tp])
         if len(devs) < dp * tp:
             raise ValueError(
                 f"dp={dp} x tp={tp} needs {dp * tp} devices, "
                 f"have {len(devs)}")
+        if routing not in ("affinity", "least_loaded"):
+            raise ValueError(
+                f"routing must be 'affinity' or 'least_loaded', "
+                f"got {routing!r}")
         engine_kw.setdefault("paged", True)
         chaos = chaos or {}
         self.dp, self.tp = dp, tp
+        self.routing = routing
+        # scale-up construction context: add_replica() builds a fresh
+        # engine exactly the way __init__ built the originals
+        self._params, self._cfg = params, cfg
+        self._devs = devs
+        self._chaos = chaos
+        self._engine_kw = engine_kw
+        self._trace_ctx = trace_ctx
+        self._blocks = list(range(dp))    # replica → tp-device block
         # ONE shared tracer across replicas: a failed-over request's
         # replay spans land on the same timeline as its first life
         self.replicas = [
@@ -4105,6 +4170,23 @@ class DataParallelServePool:
         self._gang_replica: dict[str, int] = {}
         self._pending_deaths: deque[tuple[int, str]] = deque()
         self._unsub = None
+        # prefix-affinity routing (ISSUE 14): per-replica digest of
+        # chain-hash keys resident (prefix registry) or inbound
+        # (queued/slot-resident requests) — refreshed from truth every
+        # step() and kept warm incrementally at submit.  Host-side
+        # only: no digest ever touches a device buffer.
+        self._digests: list[set] = [set() for _ in range(dp)]
+        self.routing_affinity_hits = 0
+        self.route_log: list[tuple[int, int, int]] = []  # (rid,rep,aff)
+        # SLO-driven autoscaling surface (ISSUE 14): graceful retires
+        # drain through the failover replay parking (bit-exact, and
+        # never burning a request's bounded failover budget)
+        self._pending_retire: deque[int] = deque()
+        self.autoscale_events = 0
+        self.drains = 0
+        self.drain_replays = 0
+        self.replicas_active_min = dp
+        self.replicas_active_max = dp
 
     def warmup(self) -> None:
         for eng in self.replicas:
@@ -4117,13 +4199,211 @@ class DataParallelServePool:
         """Least-loaded routing key: request count, then QUEUED PROMPT
         TOKENS as the tiebreak (two replicas with equal request counts
         can hide very different prefill backlogs), then the index for
-        determinism."""
+        determinism.  The token total is the admission queue's
+        incrementally-maintained counter, so this stays O(1) per
+        replica however deep the queue."""
         eng = self.replicas[j]
-        return (self._load(eng),
-                sum(r.prompt_len for r, _ in eng.queue), j)
+        return (self._load(eng), eng.queue.prompt_tokens, j)
 
     def _alive(self) -> list[int]:
         return [i for i in range(self.dp) if i not in self.dead_replicas]
+
+    # -- prefix-affinity routing (ISSUE 14) -----------------------------
+
+    def _chain_keys(self, prompt_np: np.ndarray) -> tuple:
+        """Chain-hash keys of the prompt's leading whole pages — the
+        SAME hash scheme the engine computes at submit, evaluated
+        host-side by the router so it can score a replica's registry
+        before placing the request."""
+        eng = self.replicas[0]
+        if not (eng.paged and eng.prefix_cache_enabled):
+            return ()
+        t = int(prompt_np.shape[0])
+        n_cacheable = (t - 1) // eng.page_size
+        return tuple(
+            hash(prompt_np[:(i + 1) * eng.page_size].tobytes())
+            for i in range(n_cacheable))
+
+    def _affinity(self, j: int, keys: tuple) -> int:
+        """Pages of this chain replica ``j`` already holds (or will —
+        its digest includes inbound requests' keys): the longest
+        CONTIGUOUS leading run, mirroring the engine's
+        ``_prefix_hit_run`` — key i alone never aliases without keys
+        < i."""
+        d = self._digests[j]
+        h = 0
+        for key in keys:
+            if key not in d:
+                break
+            h += 1
+        return h
+
+    def _route(self, candidates: list[int],
+               prompt_np: np.ndarray) -> tuple[int, int]:
+        """Pick a replica for ``prompt_np`` among ``candidates``;
+        returns ``(replica, affinity_pages)``.  Affinity mode scores
+        each candidate ``(load - affinity, load, queued_tokens, j)`` —
+        a replica holding the prompt's chain wins unless its load
+        penalty dominates.  ZERO affinity anywhere reduces the score
+        to exactly the least-loaded key, so traffic with no shared
+        prefixes routes bit-identically to the least-loaded policy."""
+        if self.routing != "affinity":
+            return min(candidates, key=self._route_key), 0
+        keys = self._chain_keys(prompt_np)
+        aff = ({j: self._affinity(j, keys) for j in candidates}
+               if keys else {})
+        if keys and any(aff.values()):
+            i = min(candidates, key=lambda j: (
+                self._load(self.replicas[j]) - aff[j],)
+                + self._route_key(j))
+            hit = aff[i]
+        else:
+            i = min(candidates, key=self._route_key)
+            hit = 0
+        if keys:
+            # warm the digest with the keys just placed: a same-tick
+            # burst of one prefix sticks together instead of
+            # scattering before the registry has cached a page
+            self._digests[i].update(keys)
+        return i, hit
+
+    def _record_route(self, rid: int, i: int, aff: int) -> None:
+        self.route_log.append((rid, i, aff))
+        _trim_acct(self.route_log)
+        if aff > 0:
+            self.routing_affinity_hits += 1
+            if self._metrics is not None:
+                self._metrics.inc("serve_routing_affinity_hits")
+        if self._tracer is not None:
+            sp = self._tracer.start_span(
+                "request.route",
+                parent=self.replicas[i]._engine_anchor,
+                attrs={"rid": rid, "replica": i,
+                       "affinity_pages": aff,
+                       "load": self._load(self.replicas[i])})
+            sp.end()
+
+    def _refresh_digests(self) -> None:
+        """Rebuild every live replica's digest from truth — registry
+        keys plus queued/slot-resident requests' chain keys — on the
+        step()/metric-echo path, so routing reads a tick-fresh digest
+        (submit-time incremental adds cover the gap between ticks and
+        any over-statement from LRU eviction self-heals here)."""
+        for j, eng in enumerate(self.replicas):
+            if j in self.dead_replicas:
+                self._digests[j] = set()
+                continue
+            d = (set(eng._prefix_cache)
+                 if eng.paged and eng.prefix_cache_enabled else set())
+            for req in eng.slot_req.values():
+                d.update(req.prefix_keys)
+            for req, _ in eng.queue:
+                d.update(req.prefix_keys)
+            self._digests[j] = d
+
+    @property
+    def routing_affinity_hit_rate(self) -> float:
+        """Fraction of routed submits (recent window) that landed on a
+        replica already holding ≥1 page of the prompt's chain."""
+        if not self.route_log:
+            return 0.0
+        return (sum(1 for _, _, a in self.route_log if a > 0)
+                / len(self.route_log))
+
+    # -- autoscaling surface (ISSUE 14) ---------------------------------
+
+    def add_replica(self, gang: str | None = None) -> int:
+        """Scale up: build one fresh replica on a free tp-device block
+        (dead replicas' blocks are reused — their host-side entries
+        replayed away at failover, their pools unreachable).  Binding
+        ``gang`` links the new replica into the same health-watch
+        eviction flow as the originals.  Returns the replica index."""
+        tp = self.tp
+        n_blocks = len(self._devs) // tp
+        used = {self._blocks[j] for j in range(len(self.replicas))
+                if j not in self.dead_replicas}
+        free = [b for b in range(n_blocks) if b not in used]
+        if not free:
+            raise ValueError(
+                f"no spare devices for a new replica: tp={tp}, "
+                f"{len(self._devs)} devices, "
+                f"{len(used)} blocks in use")
+        b = free[0]
+        i = len(self.replicas)
+        eng = ContinuousBatcher(
+            self._params, self._cfg,
+            mesh=make_serve_mesh(
+                tp, self._devs[b * tp:(b + 1) * tp]),
+            metrics=self._metrics, chaos=self._chaos.get(i),
+            tracer=self._tracer, trace_ctx=self._trace_ctx,
+            **self._engine_kw)
+        self.replicas.append(eng)
+        # one entry per replica ever built — replica indices are stable
+        # identities (dead ones keep their slot), so growth is bounded
+        # by scale-up actions, not traffic
+        # ktp: allow(KTP005) lifetime: one slot per replica identity
+        self._blocks.append(b)
+        self._digests.append(set())
+        self.dp = len(self.replicas)
+        if gang is not None:
+            self.bind_replica_gang(i, gang)
+        self.autoscale_events += 1
+        n = len(self._alive())
+        self.replicas_active_max = max(self.replicas_active_max, n)
+        if self._metrics is not None:
+            self._metrics.inc("serve_autoscale_events")
+            self._metrics.set_gauge("serve_replicas_active", float(n))
+        if self._tracer is not None:
+            sp = self._tracer.start_span(
+                "pool.scale", parent=eng._engine_anchor,
+                attrs={"direction": "up", "replica": i,
+                       "replicas_active": n})
+            sp.end()
+        return i
+
+    def retire_replica(self, i: int) -> None:
+        """Graceful scale-down: mark replica ``i`` for drain.  The
+        next step() parks its resident requests on the survivors via
+        the bit-exact failover replay (prompt + accepted tokens,
+        remaining budget) WITHOUT burning any request's bounded
+        failover budget — exactly-once completion holds through a
+        scale-down exactly as through a fault."""
+        if not (0 <= i < self.dp):
+            raise ValueError(f"no replica {i} (dp={self.dp})")
+        if i in self.dead_replicas:
+            raise ValueError(
+                f"replica {i} is already dead: "
+                f"{self.dead_replicas[i]}")
+        if i in self._pending_retire:
+            return
+        survivors = [j for j in self._alive()
+                     if j != i and j not in self._pending_retire]
+        if not survivors:
+            raise ValueError(
+                "cannot retire the last healthy replica")
+        self._pending_retire.append(i)
+
+    def _scale_down(self, i: int, done: list) -> None:
+        eng = self.replicas[i]
+        sp = None
+        if self._tracer is not None:
+            sp = self._tracer.start_span(
+                "pool.scale", parent=eng._engine_anchor,
+                attrs={"direction": "down", "replica": i})
+        eng.dead = "retired (scale-down)"
+        before = self.drain_replays
+        self._failover(i, "scale-down drain", done, drain=True)
+        self.autoscale_events += 1
+        n = len(self._alive())
+        self.replicas_active_min = min(self.replicas_active_min, n)
+        if self._metrics is not None:
+            self._metrics.inc("serve_autoscale_events")
+            self._metrics.set_gauge("serve_replicas_active", float(n))
+        if sp is not None:
+            sp.set_attr("replicas_active", n)
+            sp.set_attr("drain_replays",
+                        self.drain_replays - before)
+            sp.end()
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
@@ -4135,20 +4415,22 @@ class DataParallelServePool:
                 "no healthy replicas left: "
                 + "; ".join(f"replica {i}: {r}"
                             for i, r in self.dead_replicas.items()))
-        i = min(alive, key=self._route_key)
+        prompt_np = np.asarray(prompt, np.int32)
+        i, aff = self._route(alive, prompt_np)
         local = self.replicas[i].submit(prompt, max_new_tokens,
                                         temperature, tier=tier,
                                         tenant=tenant)
         rid = self._next_rid
         self._next_rid += 1
         self._entries[rid] = _PoolEntry(
-            rid=rid, prompt=np.asarray(prompt, np.int32),
+            rid=rid, prompt=prompt_np,
             max_new=max_new_tokens, temperature=float(temperature),
             deadline=(time.monotonic() + deadline_s
                       if deadline_s is not None else None),
             replica=i, local=local, tier=int(tier),
             tenant=str(tenant))
         self._local[(i, local)] = rid
+        self._record_route(rid, i, aff)
         return rid
 
     # -- control-plane integration ------------------------------------
@@ -4222,18 +4504,26 @@ class DataParallelServePool:
                                           e.temperature, tier=e.tier,
                                           tenant=e.tenant)
 
-    def _failover(self, i: int, reason: str, done: list) -> None:
+    def _failover(self, i: int, reason: str, done: list,
+                  drain: bool = False) -> None:
         """Re-admit every request resident on dead replica ``i`` onto
         healthy replicas via bit-exact greedy replay (prompt +
-        accepted tokens, remaining budget)."""
+        accepted tokens, remaining budget).  ``drain=True`` is the
+        GRACEFUL variant (scale-down): the same replay parking, but no
+        failover counters and no ``retries`` bump — a retire must
+        never spend a request's bounded fault budget or trip the
+        failover alarms."""
         self.dead_replicas[i] = reason
-        self.failovers += 1
-        if self._metrics is not None:
-            self._metrics.inc("serve_failover_total")
+        if drain:
+            self.drains += 1
+        else:
+            self.failovers += 1
+            if self._metrics is not None:
+                self._metrics.inc("serve_failover_total")
         t0 = time.perf_counter()
         eng = self.replicas[i]
         fo_span = None
-        if self._tracer is not None:
+        if self._tracer is not None and not drain:
             fo_span = self._tracer.start_span(
                 "pool.failover", parent=eng._engine_anchor,
                 attrs={"replica": i, "reason": reason})
@@ -4263,12 +4553,13 @@ class DataParallelServePool:
                 self._entries.pop(rid, None)
                 done.append(r)
                 continue
-            e.retries += 1
-            if e.retries > self.max_replays:
-                self._fail_entry(
-                    e, f"exceeded {self.max_replays} failovers "
-                    f"(last: {reason})", done)
-                continue
+            if not drain:
+                e.retries += 1
+                if e.retries > self.max_replays:
+                    self._fail_entry(
+                        e, f"exceeded {self.max_replays} failovers "
+                        f"(last: {reason})", done)
+                    continue
             if not alive:
                 self._fail_entry(
                     e, f"no healthy replicas left ({reason})", done)
@@ -4284,15 +4575,24 @@ class DataParallelServePool:
             e.replica, e.local = j, new_local
             self._local[(j, new_local)] = rid
             n_replayed += 1
-            self.requests_retried += 1
-            if self._metrics is not None:
-                self._metrics.inc("serve_requests_retried")
+            if drain:
+                self.drain_replays += 1
+            else:
+                self.requests_retried += 1
+                if self._metrics is not None:
+                    self._metrics.inc("serve_requests_retried")
         dt = (time.perf_counter() - t0) * 1e3
         if n_replayed or resident:
             self.replay_ms.append(dt)
             _trim_acct(self.replay_ms)
             if self._metrics is not None:
                 self._metrics.observe("serve_replay_ms", dt)
+        # the dead engine never steps again: its digest is gone and
+        # its per-replica depth gauge must not linger on /metrics
+        self._digests[i] = set()
+        if self._metrics is not None:
+            self._metrics.delete_gauge(
+                "serve_replica_queue_depth" + f"_r{i}")
         if fo_span is not None:
             fo_span.set_attr("replayed", n_replayed)
             fo_span.set_attr("resident", len(resident))
@@ -4332,6 +4632,15 @@ class DataParallelServePool:
 
     def step(self) -> list[_Request]:
         done: list[_Request] = []
+        # graceful retires drain BEFORE eviction-driven deaths: a
+        # scale-down whose gang eviction also lands in
+        # _pending_deaths must not double as a fault (the death is
+        # skipped below because the replica is already dead)
+        while self._pending_retire:
+            i = self._pending_retire.popleft()
+            if i in self.dead_replicas:
+                continue
+            self._scale_down(i, done)
         while self._pending_deaths:
             i, reason = self._pending_deaths.popleft()
             if i in self.dead_replicas:
@@ -4349,19 +4658,32 @@ class DataParallelServePool:
                 continue
             for r in rs:
                 self._finish(i, r, done)
+        if self.routing == "affinity":
+            self._refresh_digests()
+        n_alive = len(self._alive())
+        self.replicas_active_min = min(self.replicas_active_min,
+                                       n_alive)
+        self.replicas_active_max = max(self.replicas_active_max,
+                                       n_alive)
         if self._metrics is not None:
             # per-replica queue depth (the router's own signal,
-            # exported): one gauge per replica index
+            # exported): one gauge per LIVE replica index — dead
+            # replicas' gauges were deleted at failover/drain
             for i, eng in enumerate(self.replicas):
+                if i in self.dead_replicas:
+                    continue
                 self._metrics.set_gauge(
                     "serve_replica_queue_depth" + f"_r{i}",
                     float(len(eng.queue)))
+            self._metrics.set_gauge("serve_replicas_active",
+                                    float(n_alive))
         return done
 
     def drain(self, max_ticks: int = 10_000) -> list[_Request]:
         out: list[_Request] = []
         for _ in range(max_ticks):
-            if not self._entries and not self._pending_deaths:
+            if not self._entries and not self._pending_deaths \
+                    and not self._pending_retire:
                 return out
             out.extend(self.step())
         diag = "; ".join(
@@ -4530,6 +4852,22 @@ class DisaggServePool(DataParallelServePool):
     def _role_replicas(self, role: str, alive: list[int]) -> list[int]:
         return [i for i in alive if self.roles[i] == role]
 
+    def add_replica(self, gang: str | None = None,
+                    role: str = "decode") -> int:
+        """Scale up one ROLE — the autoscaler grows the decode side
+        (decode capacity is what queue-wait pressure starves first);
+        prefill growth is the operator's call."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(
+                f"role must be 'prefill' or 'decode', got {role!r}")
+        i = super().add_replica(gang)
+        self.roles.append(role)
+        if role == "prefill":
+            self.n_prefill += 1
+        else:
+            self.n_decode += 1
+        return i
+
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
                deadline_s: float | None = None, tier: int = 0,
@@ -4542,34 +4880,38 @@ class DisaggServePool(DataParallelServePool):
                             for i, r in self.dead_replicas.items()))
         pref = self._role_replicas("prefill", alive)
         dec = self._role_replicas("decode", alive)
+        prompt_np = np.asarray(prompt, np.int32)
         if pref and dec and max_new_tokens > 1:
             # the disaggregated fast path: prefill leg emits ONE token
-            i = min(pref, key=self._route_key)
+            # — affinity scores the PREFILL role (that is where the
+            # prompt's chain pages alias)
+            i, aff = self._route(pref, prompt_np)
             local = self.replicas[i].submit(
                 prompt, 1, temperature, migrate_out=True, tier=tier,
                 tenant=tenant)
         elif pref and max_new_tokens == 1:
             # satisfied entirely by prefill — no migration needed
-            i = min(pref, key=self._route_key)
+            i, aff = self._route(pref, prompt_np)
             local = self.replicas[i].submit(prompt, 1, temperature,
                                             tier=tier, tenant=tenant)
         else:
             # degraded: one whole role is dead — serve symmetrically
             # on whatever survives
-            i = min(alive, key=self._route_key)
+            i, aff = self._route(alive, prompt_np)
             local = self.replicas[i].submit(prompt, max_new_tokens,
                                             temperature, tier=tier,
                                             tenant=tenant)
         rid = self._next_rid
         self._next_rid += 1
         self._entries[rid] = _PoolEntry(
-            rid=rid, prompt=np.asarray(prompt, np.int32),
+            rid=rid, prompt=prompt_np,
             max_new=max_new_tokens, temperature=float(temperature),
             deadline=(time.monotonic() + deadline_s
                       if deadline_s is not None else None),
             replica=i, local=local, tier=int(tier),
             tenant=str(tenant))
         self._local[(i, local)] = rid
+        self._record_route(rid, i, aff)
         return rid
 
     def _replay_submit(self, replay, remaining: int,

@@ -178,6 +178,19 @@ name                            kind       meaning
                                            cancels); suffixed
                                            ``_t<k>`` per tier
                                            (ISSUE 13)
+``serve_routing_affinity_hits``  counter   pool submits routed to a
+                                           replica already holding ≥1
+                                           page of the prompt's chain
+                                           (prefix-affinity routing,
+                                           ISSUE 14)
+``serve_autoscale_events``      counter    replica-pool scale actions
+                                           (up = gang spawn + fresh
+                                           replica, down = graceful
+                                           drain through the replay
+                                           parking; ISSUE 14)
+``serve_replicas_active``       gauge      live replicas in the pool
+                                           after deaths, retires, and
+                                           scale-ups (ISSUE 14)
 ==============================  =========  ============================
 
 Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as
@@ -190,7 +203,12 @@ Chrome/Perfetto JSON, not scraped): ``sched.schedule``, ``sched.bind``,
 ``request.preempt`` / ``request.resume`` (attrs: ``rid``, ``slot``,
 ``tier``, ``preemptions`` — the park/replay handshake of low-priority
 preemption, ISSUE 13),
-``request.quarantine``, ``pool.failover``, ``engine.tick``,
+``request.quarantine``, ``pool.failover``,
+``request.route`` (attrs: ``rid``, ``replica``, ``affinity_pages``,
+``load`` — the prefix-affinity routing decision, ISSUE 14),
+``pool.scale`` (attrs: ``direction``, ``replica``,
+``replicas_active``, ``drain_replays`` — one autoscale action,
+ISSUE 14), ``engine.tick``,
 ``engine.dispatch``, ``engine.verify``, ``engine.collect``,
 ``engine.admit``, plus ``sched.<kind>`` instants forwarded from
 ScheduleTrace for linked gangs.  The serve pod echoes the span census
@@ -315,6 +333,14 @@ class MetricsRegistry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = value
+
+    def delete_gauge(self, name: str) -> None:
+        """Drop a gauge from the scrape surface entirely (idempotent).
+        Per-instance gauges (``serve_replica_queue_depth_r<i>``) use
+        this when the instance goes away — a drained replica must
+        vanish from ``/metrics``, not freeze at its last depth."""
+        with self._lock:
+            self._gauges.pop(name, None)
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:

@@ -217,7 +217,14 @@ class DeviceScheduler:
                 ("serve_requests_shed", "serving_requests_shed"),
                 ("serve_requests_preempted",
                  "serving_requests_preempted"),
-                ("serve_deadline_miss", "serving_deadline_miss")):
+                ("serve_deadline_miss", "serving_deadline_miss"),
+                # the closed loop (ISSUE 14): routing affinity and
+                # autoscale state become first-class scheduler signals
+                ("serve_replicas_active", "serving_replicas_active"),
+                ("serve_autoscale_events",
+                 "serving_autoscale_events"),
+                ("serve_routing_affinity_hits",
+                 "serving_routing_affinity_hits")):
             v = out.get(src)
             if v is not None:
                 self.metrics.set_gauge(dst, v)
@@ -1532,17 +1539,25 @@ class DeviceScheduler:
                 out.append(p)
         return out
 
-    def evict_gang(self, gang: str, reason: str) -> list[str]:
+    def evict_gang(self, gang: str, reason: str,
+                   requeue: bool = True) -> list[str]:
         """Whole-gang eviction + requeue (used by preemption here and by
         the fault-recovery controller): delete every live member (kills
         containers via node-agent reconcile, frees chips via the
         return-resources path), then recreate identical PENDING pods —
         same name/spec/gang, no binding, no allocation annotation — so the
-        next pass schedules the gang fresh.  Returns requeued pod names."""
-        with self._lock:
-            return self._evict_gang_locked(gang, reason)
+        next pass schedules the gang fresh.  Returns requeued pod names.
 
-    def _evict_gang_locked(self, gang: str, reason: str) -> list[str]:
+        ``requeue=False`` is the SCALE-DOWN variant (ISSUE 14): the
+        gang's capacity is being retired on purpose, so members are
+        deleted but never recreated — the delete still flows to every
+        watcher (the serving pool's health watch sees it), chips still
+        free, and nothing re-enters the queue."""
+        with self._lock:
+            return self._evict_gang_locked(gang, reason, requeue)
+
+    def _evict_gang_locked(self, gang: str, reason: str,
+                           requeue: bool = True) -> list[str]:
         from kubegpu_tpu.kubemeta import NotFound
         from kubegpu_tpu.kubemeta.objects import ObjectMeta, PodStatus
 
@@ -1560,6 +1575,8 @@ class DeviceScheduler:
             # (e.g. scheduler used standalone in tests) — idempotent, the
             # first call pops the pod from the gang map.
             self.return_pod_resources(pod.name, pod.metadata.namespace)
+        if not requeue:
+            return [pod.name for pod in pods]
         from kubegpu_tpu.kubemeta.codec import QUEUED_AT_KEY
 
         requeued: list[str] = []
@@ -1582,6 +1599,37 @@ class DeviceScheduler:
             self.api.create("Pod", fresh)
             requeued.append(fresh.name)
         return requeued
+
+    def spawn_serving_gang(self, gang: str, size: int = 1,
+                           chips: int = 1,
+                           namespace: str = "default",
+                           mesh_axes: dict[str, int] | None = None,
+                           role: str | None = None) -> list[str]:
+        """Scale-up half of the serving control loop (ISSUE 14):
+        create ``size`` serving pods under gang ``gang`` and run one
+        scheduling pass so they bind immediately — the SAME gang-
+        scheduled path every hand-submitted serving pod takes (serving
+        axis weights, role annotation and all), just driven by the
+        autoscaler instead of an operator.  Returns the pod names;
+        node agents start the containers on their next reconcile."""
+        from kubegpu_tpu.cluster import tpu_pod   # lazy: no cycle
+        from kubegpu_tpu.kubemeta import GangSpec
+        from kubegpu_tpu.kubemeta.codec import set_pod_serve_role
+
+        names: list[str] = []
+        for k in range(size):
+            pod = tpu_pod(
+                f"{gang}-{k}", chips=chips, workload="serving",
+                gang=GangSpec(name=gang, size=size, index=k),
+                mesh_axes={"tp": chips} if mesh_axes is None
+                else mesh_axes,
+                namespace=namespace, command=["noop"])
+            if role is not None:
+                set_pod_serve_role(pod, role)
+            self.api.create("Pod", pod)
+            names.append(pod.metadata.name)
+        self.run_once()
+        return names
 
     # ------------------------------------------------------------------
     # Request construction

@@ -408,7 +408,16 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                 ("serve_requests_resumed",
                  getattr(eng, "requests_resumed", 0)),
                 ("serve_deadline_miss",
-                 getattr(eng, "deadline_misses", 0))):
+                 getattr(eng, "deadline_misses", 0)),
+                # closed-loop echo (ISSUE 14): routing affinity and
+                # autoscale state per pod — a bare engine echoes the
+                # single-replica identity (1 replica, no routing)
+                ("serve_routing_affinity_hits",
+                 getattr(eng, "routing_affinity_hits", 0)),
+                ("serve_autoscale_events",
+                 getattr(eng, "autoscale_events", 0)),
+                ("serve_replicas_active",
+                 len(eng._alive()) if hasattr(eng, "_alive") else 1)):
             print(json.dumps({"metric": name, "value": value}))
         if tracer is not None:
             # trace echo: span count is harvestable; the full Perfetto

@@ -271,3 +271,52 @@ def test_serving_bench_smoke_parses_and_carries_keys():
     # retune that stops exercising it would pass the gates vacuously)
     assert sg["tiered"]["preempted"] >= 1
     assert sg["tiered"]["resumed"] == sg["tiered"]["preempted"]
+
+    # prefix-affinity routing (ISSUE 14 tentpole, routing half): same
+    # seeded bursty shared-prefix trace at equal chips, affinity vs
+    # least-loaded.  The gates are tick-pure: >= 1.3x top-tier
+    # goodput-under-SLO, bit-exact tokens (routing is host-side), zero
+    # lost/duplicated — and the mechanism must actually fire (affinity
+    # hits on the affinity leg, none on the least-loaded leg).
+    if len(jax.devices()) >= 2:
+        pa = doc["cb_prefix_affinity"]
+        assert pa["protocol"] == "same_trace_equal_chip_ab"
+        assert pa["lost"] == 0 and pa["duplicated"] == 0
+        assert pa["bit_exact"] is True, \
+            "routing placement changed a token stream"
+        assert pa["top_tier_goodput_ratio_x"] >= 1.3, pa
+        assert pa["affinity"]["affinity_hits"] >= 1
+        assert pa["affinity"]["affinity_hit_rate"] > 0.5
+        assert pa["least_loaded"]["affinity_hits"] == 0
+        assert pa["affinity"]["top_tier"]["attainment"] \
+            > pa["least_loaded"]["top_tier"]["attainment"]
+        for leg in ("affinity", "least_loaded"):
+            assert pa[leg]["completed"] + pa[leg]["failed"] \
+                == pa["requests"], leg
+            assert pa[leg]["ttft_p99_ticks"] > 0, leg
+
+    # SLO-driven autoscaling (ISSUE 14 tentpole, scaling half): one
+    # seeded burst drives the replica pool up then back down THROUGH
+    # the extender gang path, with the scale-down draining via the
+    # bit-exact replay parking — exactly-once and token parity must
+    # survive the whole cycle, and the drain must carry real work.
+    if len(jax.devices()) >= 2:
+        au = doc["cb_autoscale"]
+        assert au["protocol"] == "closed_loop_autoscale"
+        assert au["scale_ups"] >= 1 and au["scale_downs"] >= 1
+        assert au["replicas_max"] > au["replicas_min"]
+        assert au["drains"] >= 1
+        assert au["drain_replays"] >= 1, \
+            "scale-down drained an empty replica: replay parking " \
+            "not exercised"
+        assert au["failovers"] == 0      # a drain is not a fault
+        assert au["exactly_once"] is True
+        assert au["lost"] == 0 and au["duplicated"] == 0
+        assert au["bit_exact"] is True, \
+            "a drained/replayed request drifted off unloaded tokens"
+        assert au["completed"] + au["failed"] == au["requests"]
+        # up happens under burst pressure, down after the calm
+        # hysteresis — strictly later by construction
+        ups = [t for t, d, _ in au["events"] if d == "up"]
+        downs = [t for t, d, _ in au["events"] if d == "down"]
+        assert ups and downs and min(downs) > min(ups)
