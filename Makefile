@@ -5,8 +5,8 @@
 PY ?= python
 
 .PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
-        fused-smoke hbm-smoke disagg-smoke slo-smoke route-smoke \
-        analyze clean
+        fused-smoke hbm-smoke kv-smoke disagg-smoke slo-smoke \
+        route-smoke analyze clean
 
 all: native
 
@@ -69,6 +69,29 @@ hbm-smoke: analyze              # ISSUE 10 HBM-lean serving: donation
 		r = row['cb_hbm_donation']; \
 		assert r['bit_exact'] and r['aliases_covered']; \
 		assert r['pool_bytes_ratio'] >= 1.4, r['pool_bytes_ratio']"
+
+kv-smoke: analyze               # ISSUE 15 kv compression & eviction:
+	# the bf16/int8/int4 page-pool suites (refcount law, donated-
+	# handle hygiene, chain migration with grouped scales, eviction
+	# rails), then the cb_kv_capacity gate — >= 1.5x concurrent slots
+	# inside the donation-off int8 byte budget at a bounded MEASURED
+	# quality delta, with both eviction policies actually dropping
+	# pages.  The analyze dep re-proves the int4 engine's donation
+	# aliasing + its census signatures (8, identical to plain).
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_page_pool.py -q -k "int4 or Int4 or Evict or evict"
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu $(PY) -c "import json; \
+		from kubegpu_tpu.benchmark import run_serving_bench_smoke; \
+		row = run_serving_bench_smoke(legs=['cb_kv_capacity']); \
+		print(json.dumps(row, indent=1)); \
+		r = row['cb_kv_capacity']; \
+		assert r['capacity_ok'], r; \
+		assert r['slots_ratio'] >= 1.5, r['slots_ratio']; \
+		assert r['quality_ok'], r['quality_delta_int4']; \
+		assert all(v['pages_evicted'] >= 1 \
+			for v in r['eviction'].values()), r['eviction']"
 
 disagg-smoke: analyze           # ISSUE 11 disaggregated serving: page-
 	# chain export/import property tests (bit-exact pages + refcounts,

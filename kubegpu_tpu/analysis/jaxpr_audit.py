@@ -18,7 +18,8 @@ jaxpr recursively — through ``pjit`` / ``scan`` / ``cond`` /
   perf bug hiding in plain sight).
 - **CEN001**: the compile-signature census.  A scripted workload
   (admission wave → chunked prefill → spec ticks → fused K∈{1,4} →
-  quarantine replay) drives two engines end to end while a shim over
+  quarantine replay) drives three engines (plain bf16, spec, packed
+  int4) end to end while a shim over
   ``eng._fns`` records the lowering signature of every dispatch; the
   distinct set must EQUAL :func:`expected_signatures` — a signature
   outside the set is a recompilation hazard (reported with the
@@ -162,7 +163,8 @@ AUDIT_SHAPE = dict(n_slots=2, stride=2, prompt_buckets=(8, 16),
                    fused_ticks=4)
 
 
-def build_audit_engine(*, spec: bool = False, kv_int8: bool = False):
+def build_audit_engine(*, spec: bool = False, kv_int8: bool = False,
+                       kv_bits: int | None = None):
     import jax
     from kubegpu_tpu.models import LlamaConfig, llama_init
     from kubegpu_tpu.models.serve import ContinuousBatcher
@@ -173,6 +175,8 @@ def build_audit_engine(*, spec: bool = False, kv_int8: bool = False):
         kw.update(spec_gamma=2, draft_layers=1)
     if kv_int8:
         kw.update(kv_int8=True)
+    if kv_bits is not None:
+        kw.update(kv_bits=kv_bits)
     return ContinuousBatcher(params, cfg, **kw)
 
 
@@ -239,13 +243,15 @@ def representative_args(eng) -> dict:
 def audit_engine_executables(blessings: Blessings | None = None):
     """Trace + audit every executable of the audit engines (a
     bf16 spec engine covers all eight executables; a kv_int8 engine
-    re-covers the quantized attention path).  Returns
-    ``(findings, summary)``."""
+    re-covers the quantized attention path; a kv_bits=4 engine
+    re-covers the packed-nibble path with its grouped scales).
+    Returns ``(findings, summary)``."""
     blessings = blessings or Blessings.load()
     findings: list[Finding] = []
     summary: dict = {"executables": {}}
     engines = (("bf16", build_audit_engine(spec=True)),
-               ("int8", build_audit_engine(kv_int8=True)))
+               ("int8", build_audit_engine(kv_int8=True)),
+               ("int4", build_audit_engine(kv_bits=4)))
     for label, eng in engines:
         argsets = representative_args(eng)
         for i, name in enumerate(EXECUTABLES):
@@ -398,30 +404,38 @@ def _drive_spec(eng) -> None:
 
 
 def run_census_workloads():
-    """Build both engines, shim them, run the scripted workloads.
-    Returns ``({"plain": shim, "spec": shim}, coverage_problems)`` —
-    a workload that drains without hitting its phases (no quarantine,
-    no replay, work left over) silently shrinks the census, so that is
-    reported as a CEN001 coverage loss, not ignored."""
+    """Build the engines, shim them, run the scripted workloads.
+    Returns ``({"plain": shim, "spec": shim, "q4": shim},
+    coverage_problems)`` — a workload that drains without hitting its
+    phases (no quarantine, no replay, work left over) silently shrinks
+    the census, so that is reported as a CEN001 coverage loss, not
+    ignored.  The ``q4`` engine re-runs the full plain script on the
+    packed-int4 pool: the signature SET must match plain's exactly
+    (``_sig_of`` elides the pool pytree, so a kv format that leaked
+    into a top-level argument shape would surface here), and the drive
+    doubles as the eviction-off int4 chaos/replay determinism proof —
+    the quarantine replay requantizes the same prompt bytes and must
+    drain exactly once."""
     shims = {}
     problems: list[str] = []
-    eng = build_audit_engine()
-    shims["plain"] = _CensusShim(eng)
-    _drive_plain(eng)
-    if eng.slots_quarantined < 1 or eng.requests_retried < 1:
-        problems.append(
-            "plain workload: the quarantine→replay phase never fired "
-            f"(quarantined={eng.slots_quarantined}, "
-            f"retried={eng.requests_retried})")
-    if eng.slot_req or eng.queue:
-        problems.append(
-            f"plain workload did not drain ({len(eng.slot_req)} slots "
-            f"busy, {len(eng.queue)} queued)")
-    if eng.chains_exported < 1 or eng.chains_imported < 1:
-        problems.append(
-            "plain workload: the migration phase never fired "
-            f"(exported={eng.chains_exported}, "
-            f"imported={eng.chains_imported})")
+    for label, eng in (("plain", build_audit_engine()),
+                       ("q4", build_audit_engine(kv_bits=4))):
+        shims[label] = _CensusShim(eng)
+        _drive_plain(eng)
+        if eng.slots_quarantined < 1 or eng.requests_retried < 1:
+            problems.append(
+                f"{label} workload: the quarantine→replay phase never "
+                f"fired (quarantined={eng.slots_quarantined}, "
+                f"retried={eng.requests_retried})")
+        if eng.slot_req or eng.queue:
+            problems.append(
+                f"{label} workload did not drain ({len(eng.slot_req)} "
+                f"slots busy, {len(eng.queue)} queued)")
+        if eng.chains_exported < 1 or eng.chains_imported < 1:
+            problems.append(
+                f"{label} workload: the migration phase never fired "
+                f"(exported={eng.chains_exported}, "
+                f"imported={eng.chains_imported})")
     eng_s = build_audit_engine(spec=True)
     shims["spec"] = _CensusShim(eng_s)
     _drive_spec(eng_s)
@@ -498,7 +512,13 @@ def expected_signatures() -> dict[str, frozenset]:
         verify,              # K=1 verify while the queue is non-empty
         vfused,              # steady-state fused speculative K=4
     }
-    return {"plain": frozenset(plain), "spec": frozenset(spec)}
+    # The int4 engine's signature set is IDENTICAL to plain's: the kv
+    # format only changes pool/chain pytree leaves, which _sig_of
+    # elides by design.  A q4-only signature appearing here would mean
+    # the packed format leaked into a top-level argument — exactly the
+    # recompile hazard the census exists to catch.
+    return {"plain": frozenset(plain), "spec": frozenset(spec),
+            "q4": frozenset(plain)}
 
 
 def _shape_diff(sig: str, expected: set) -> str:

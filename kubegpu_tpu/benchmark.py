@@ -1637,6 +1637,13 @@ def _families_bench(cfg, params, on_tpu) -> dict:
         out["cb_fused_ticks"] = _cb_fused_bench(
             qparams, cfg, slots=8, prompt=512, new=64, stride=16,
             reqs=24, page=128)
+        # grouped int4 KV + attention-aware eviction (ISSUE 15): the
+        # equal-budget capacity A/B at flagship serving scale — the
+        # 1024-token prompts span 8 pages, so the eviction legs ride
+        # the same shape
+        out["cb_kv_capacity"] = _cb_kv_capacity_bench(
+            qparams, cfg, slots=8, prompt=1024, new=64, stride=16,
+            page=128, reqs=16)
     else:
         out["continuous_batching"] = _cb_ab_bench(
             qparams, cfg, slots=2, prompt=8, new=4, stride=2,
@@ -1655,9 +1662,10 @@ def _families_bench(cfg, params, on_tpu) -> dict:
             qparams, cfg, dense_slots=2, paged_slots=4,
             buckets=(8, 16), mix=[(8, 4), (8, 4), (16, 4)],
             reqs=5, stride=2, page=8, iters=iters)
-        # cb_fused_ticks rides the on_tpu branch + the bench smoke
-        # (like cb_tp_serving): the tiny tier-1 path already pays for
-        # the full fused K sweep in run_serving_bench_smoke
+        # cb_fused_ticks and cb_kv_capacity ride the on_tpu branch +
+        # the bench smoke (like cb_tp_serving): the tiny tier-1 path
+        # already pays for the full fused K sweep and the int4
+        # capacity A/B in run_serving_bench_smoke
 
     # --- train the bench model on a cyclic pattern --------------------
     # One training pays for TWO honest speculative rows: the PLD
@@ -1958,7 +1966,8 @@ def _cb_hbm_bench(params, cfg, slots: int, prompt: int, new: int,
     # compile-time aliasing proof — per executable, per engine flavor
     aliases = {}
     for label, kw in (("bf16", dict(spec=True)),
-                      ("int8", dict(kv_int8=True))):
+                      ("int8", dict(kv_int8=True)),
+                      ("int4", dict(kv_bits=4))):
         rep = donation_report(build_audit_engine(**kw))
         aliases[label] = {
             name: {"aliased_params": r["aliased_params"],
@@ -2000,6 +2009,114 @@ def _cb_hbm_bench(params, cfg, slots: int, prompt: int, new: int,
             "fits_budget": big_eng.hbm_peak_bytes <= budget,
             "tokens": sum(len(t) for t in big_toks.values()),
         },
+    }
+
+
+def _cb_kv_capacity_bench(params, cfg, slots: int, prompt: int,
+                          new: int, stride: int, page: int,
+                          reqs: int) -> dict:
+    """Spend the reclaimed HBM twice (ISSUE 15): the grouped-int4 KV
+    pool must fit >= 1.5x the concurrent slots inside the byte budget
+    the DONATION-OFF int8 engine needed for the same request mix, at a
+    bounded, MEASURED quality delta — plus the attention-aware page
+    eviction legs (window + mass) with their own measured deltas.
+
+    Method: one bf16 reference run pins the greedy token streams;
+    the donation-off int8 run at ``slots`` slots sets ``byte_budget``
+    (its lifetime HBM peak); the int4 engine then runs >= 1.5x the
+    slots (and proportionally more requests) and must PEAK inside that
+    budget while completing every request.  Quality deltas are
+    greedy-token disagreement vs the bf16 reference per request —
+    reported, pushed through ``note_kv_quality`` (so the
+    ``serve_kv_quality_delta`` gauge carries the measured number, not
+    a guess), and gated against ``quality_bound``.  The bound is loose
+    (0.8) because the tiny random-weight smoke model has near-tied
+    logits everywhere, so 4-bit noise cascades at the first flipped
+    token; directed pool-byte checks (tests/test_page_pool.py) pin the
+    actual dequantization error to int4 tolerance.
+
+    ``prompt`` must span >= 4 pages: every leg (capacity AND eviction)
+    shares the same request mix so the ONE bf16 reference prices them
+    all, and the eviction rails refuse to evict below 3 live prompt
+    pages."""
+    import numpy as np
+
+    from kubegpu_tpu.models.serve import ContinuousBatcher
+
+    def run(pr_len, n_slots, n_reqs, n_new, **kw):
+        eng = ContinuousBatcher(
+            params, cfg, n_slots=n_slots, stride=stride,
+            prompt_buckets=(pr_len,), paged=True, page_size=page, **kw)
+        base = np.arange(pr_len) % cfg.vocab_size
+        for i in range(n_reqs):
+            eng.submit((base + i) % cfg.vocab_size, n_new)
+        done = eng.drain()
+        eng.check_page_invariants()
+        return {r.rid: list(r.tokens) for r in done}, eng
+
+    def delta_vs(toks, ref):
+        # greedy-token disagreement on the rid set BOTH runs served
+        # (rids are submit-ordered, so rid i is the same prompt in
+        # every leg); 0.0 == bit-exact streams
+        pairs = [(t, r) for rid in ref for t, r in
+                 zip(toks[rid], ref[rid])]
+        return 1.0 - sum(t == r for t, r in pairs) / max(len(pairs), 1)
+
+    ref_toks, _ = run(prompt, slots, reqs, new)
+    off8_toks, off8_eng = run(prompt, slots, reqs, new,
+                              kv_int8=True, donate=False)
+    budget = off8_eng.hbm_peak_bytes
+    # the acceptance floor is 1.5x; the packed pool (half the int8
+    # bytes) plus donation (no second transient copy) delivers 2x
+    # comfortably, so claim it and let fits_budget prove it
+    slots_hi = slots * 2
+    hi_reqs = reqs * slots_hi // slots
+    hi_toks, hi_eng = run(prompt, slots_hi, hi_reqs, new, kv_bits=4)
+    delta4 = delta_vs(hi_toks, ref_toks)
+    hi_eng.note_kv_quality(delta4)
+    fits = hi_eng.hbm_peak_bytes <= budget
+
+    # eviction legs: same shapes, same bf16 reference
+    ev = {}
+    for policy, param in (("window", 2.0 * page), ("mass", 0.25)):
+        toks, eng = run(prompt, slots, reqs, new,
+                        kv_bits=4, evict_policy=policy,
+                        evict_param=param)
+        d = delta_vs(toks, ref_toks)
+        eng.note_kv_quality(d)
+        ev[policy] = {
+            "evict_param": param,
+            "pages_evicted": eng.pages_evicted,
+            "quality_delta": round(d, 4),
+            "completed": len(toks),
+            "tokens": sum(len(t) for t in toks.values()),
+        }
+
+    return {
+        "protocol": "equal_budget_capacity_ab",
+        "byte_budget": budget,
+        "budget_engine": {
+            "kv_bits": 8, "donate": False, "n_slots": slots,
+            "requests": reqs,
+            "peak_bytes": off8_eng.hbm_peak_bytes,
+            "pool_bytes": off8_eng.hbm_pool_bytes,
+            "quality_delta": round(delta_vs(off8_toks, ref_toks), 4),
+        },
+        "int4_engine": {
+            "kv_bits": 4, "donate": True, "n_slots": slots_hi,
+            "kv_group": hi_eng.kv_group, "requests": hi_reqs,
+            "peak_bytes": hi_eng.hbm_peak_bytes,
+            "pool_bytes": hi_eng.hbm_pool_bytes,
+            "completed": len(hi_toks),
+            "tokens": sum(len(t) for t in hi_toks.values()),
+        },
+        "slots_ratio": round(slots_hi / slots, 3),
+        "fits_budget": fits,
+        "capacity_ok": fits and slots_hi / slots >= 1.5,
+        "quality_delta_int4": round(delta4, 4),
+        "quality_bound": 0.8,
+        "quality_ok": delta4 <= 0.8,
+        "eviction": ev,
     }
 
 
@@ -2568,6 +2685,9 @@ def run_serving_bench_smoke(legs=None) -> dict:
             reqs=3, ks=(1, 4)),
         "cb_hbm_donation": lambda: _cb_hbm_bench(
             params, cfg, slots=2, prompt=16, new=8, stride=2, page=8,
+            reqs=4),
+        "cb_kv_capacity": lambda: _cb_kv_capacity_bench(
+            params, cfg, slots=2, prompt=32, new=8, stride=2, page=8,
             reqs=4),
         "cb_disagg": lambda: _cb_disagg_bench(
             params, cfg, slots=2, prompt=16, new=24, stride=2, page=8,
@@ -3214,6 +3334,34 @@ def summarize_bench(out: dict) -> dict:
             and (cols := _routing_cols(row)) is not None}
         if routing:
             s["serving_routing"] = routing
+        # kv-capacity columns (ISSUE 15 sat.) — sparse like the
+        # routing table: [slots-at-budget, measured quality delta]
+        # for rows that ran the compressed-pool capacity A/B; the
+        # slots column flags a budget bust loudly instead of hiding
+        # it behind a bare ratio
+
+        def _capacity_cols(row):
+            ratio = row.get("slots_ratio")
+            delta = row.get("quality_delta_int4",
+                            row.get("quality_delta"))
+            if ratio is None and delta is None:
+                return None
+            slots_at = None
+            if ratio is not None:
+                slots_at = f"{ratio}x" + (
+                    "" if row.get("fits_budget", True) else "!budget")
+            return [slots_at, delta]
+
+        capacity = {
+            name: cols
+            for name, row in list(fam.items()) + [("serving", sv)]
+            if isinstance(row, dict) and "skipped" not in row
+            and "error" not in row
+            and (name == "serving" or name.startswith(
+                ("cb", "continuous_batching")))
+            and (cols := _capacity_cols(row)) is not None}
+        if capacity:
+            s["serving_capacity"] = capacity
     elif isinstance(m, dict):
         s["model"] = {"error": str(m["error"])[:120]}
 

@@ -28,6 +28,7 @@ from jax import lax
 
 from kubegpu_tpu.models.llama import LlamaConfig, _rmsnorm, _rope
 from kubegpu_tpu.ops.flash_attention import NEG_INF
+from kubegpu_tpu.ops.kvquant import quantize_rows
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int,
@@ -51,14 +52,11 @@ def init_kv_cache(cfg: LlamaConfig, batch: int,
             "v_scale": jnp.ones(sshape, jnp.float32)}
 
 
-def _quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Per-(batch, head, token) symmetric int8 over the channel dim.
-    x: [B, H, T, D] → (int8 values, f32 scales [B, H, T])."""
-    xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=-1)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
-    return q.astype(jnp.int8), scale
+# Quantizer math lives in the shared ops module (ISSUE 15 satellite:
+# the dense int8 cache, the paged int8 pool, and the packed int4 pool
+# all rate through ONE implementation); the underscore alias keeps the
+# historical import path every pool write site uses.
+_quantize_rows = quantize_rows
 
 
 def _cached_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,

@@ -248,8 +248,8 @@ def _paged_row_step(params: dict, tokens: jax.Array, pool: dict,
                     pt: jax.Array, tvec: jax.Array, tpad: jax.Array,
                     d0: jax.Array, buf: dict, pos: jax.Array,
                     j: jax.Array, cfg: LlamaConfig, interpret: bool,
-                    ffn=None, tp_axis: str | None = None
-                    ) -> tuple[jax.Array, dict]:
+                    ffn=None, tp_axis: str | None = None,
+                    collect_mass: bool = False):
     """One decode step for every slot against the PAGED pool: flushed
     history via the pallas paged-attention kernel (reads only the pages
     each row actually holds), this block's keys via the write buffer,
@@ -285,23 +285,30 @@ def _paged_row_step(params: dict, tokens: jax.Array, pool: dict,
                                       (0, 0, j, 0))
         bv = lax.dynamic_update_slice(bv, v.astype(bv.dtype),
                                       (0, 0, j, 0))
-        o_p, m_p, l_p = paged_attention(
+        parts = paged_attention(
             q[:, :, 0, :], pool_k, pool_v, pt, li, tvec, tpad, d0,
-            k_scale=k_scale, v_scale=v_scale, interpret=interpret)
+            k_scale=k_scale, v_scale=v_scale, interpret=interpret,
+            collect_mass=collect_mass)
+        o_p, m_p, l_p = parts[0], parts[1], parts[2]
         o_b, m_b, l_b = _attend_buffer_partials(q, bk, bv, j)
         o = merge_partials(o_p, m_p, l_p, o_b, m_b, l_b)
         o = o[:, :, None, :].astype(x.dtype)            # [B,Hq,1,D]
-        return _attn_finish(x, o, lp, cfg, ffn, tp_axis=tp_axis), \
-            (bk, bv)
+        ys = (bk, bv, parts[3]) if collect_mass else (bk, bv)
+        return _attn_finish(x, o, lp, cfg, ffn, tp_axis=tp_axis), ys
 
     lidx = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    x, (bk_new, bv_new) = lax.scan(
+    x, ys = lax.scan(
         layer, x, (params["layers"], buf["k"], buf["v"], lidx))
+    bk_new, bv_new = ys[0], ys[1]
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)[:, 0]
     if tp_axis is not None:
         logits = lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
-    return logits, {"k": bk_new, "v": bv_new}
+    buf_new = {"k": bk_new, "v": bv_new}
+    if collect_mass:
+        # mean over layers: ys[2] is [L, B, max_pages] per-page mass
+        return logits, buf_new, jnp.mean(ys[2], axis=0)
+    return logits, buf_new
 
 
 def _flush_buffer_paged(pool: dict, buf: dict, pt: jax.Array,
@@ -322,7 +329,26 @@ def _flush_buffer_paged(pool: dict, buf: dict, pt: jax.Array,
     off = phys0 % page_size
 
     quant = "k_scale" in pool
-    if quant:
+    q4 = quant and pool["k"].dtype == jnp.uint8
+    if q4:
+        # packed int4 with per-group scales: group size is recoverable
+        # from the pool layout (P / scale lanes), and the engine
+        # guarantees kv_group | stride and page-aligned decode starts,
+        # so every block write is group-aligned — quantization never
+        # straddles a write boundary (that alignment is what keeps q4
+        # writes exactly-once under chaos replay)
+        from kubegpu_tpu.ops.kvquant import quantize_groups_q4
+        gq = page_size // pool["k_scale"].shape[3]
+        kq, ksc = quantize_groups_q4(
+            buf["k"].reshape((-1,) + buf["k"].shape[2:]), gq)
+        vq, vsc = quantize_groups_q4(
+            buf["v"].reshape((-1,) + buf["v"].shape[2:]), gq)
+        sshape = buf["k"].shape[:-1][:-1] + (buf["k"].shape[3] // gq,)
+        qbuf = {"k": kq.reshape(buf["k"].shape[:-1] + (kq.shape[-1],)),
+                "v": vq.reshape(buf["v"].shape[:-1] + (vq.shape[-1],)),
+                "k_scale": ksc.reshape(sshape),
+                "v_scale": vsc.reshape(sshape)}
+    elif quant:
         # ONE vectorized quantize of the whole buffer; the per-slot
         # loop below only scatters (a review catch: quantizing inside
         # the sequential loop serialized n_slots quantize ops on the
@@ -342,7 +368,7 @@ def _flush_buffer_paged(pool: dict, buf: dict, pt: jax.Array,
         start = (0, page[b], 0, off[b], 0)
         if quant:
             pk, pv, pks, pvs = pool_st
-            s4 = (0, page[b], 0, off[b])
+            s4 = (0, page[b], 0, off[b] // gq if q4 else off[b])
             pk = lax.dynamic_update_slice(
                 pk, lax.dynamic_slice_in_dim(qbuf["k"], b, 1, axis=1),
                 start)
@@ -589,7 +615,9 @@ def make_serve_mesh(tp: int, devices=None):
 def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                       page_size: int, stride: int, top_k: int = 0,
                       sampling: bool = False, interpret: bool = False,
-                      kv_int8: bool = False, ffn_factory=None,
+                      kv_int8: bool = False, kv_bits: int = 16,
+                      kv_group: int = 0, evict_mass: bool = False,
+                      ffn_factory=None,
                       ffn_cfg=None, mesh=None,
                       quant_weights: bool = False,
                       spec_gamma: int = 0, draft_layers: int = 0,
@@ -625,11 +653,31 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
     retires a lane mid-block when it exhausts its token ``budget``,
     emits ``eos_id``, would flush past its page allocation ``cap``
     (the stall flag the host reads back), or trips the non-finite
-    quarantine flag.  ``eos_id < 0`` disables the EOS freeze."""
+    quarantine flag.  ``eos_id < 0`` disables the EOS freeze.
+
+    ``kv_bits = 4`` (with ``kv_group`` tokens per scale group) selects
+    the PACKED int4 pool format (ISSUE 15): uint8 value leaves hold
+    two nibbles per byte and every write path quantizes per group
+    through :mod:`kubegpu_tpu.ops.kvquant` — the same module the int8
+    paths rate through.  ``evict_mass`` makes ``decode_block`` emit a
+    fourth output, the per-page attention-mass accumulator harvested
+    from the paged kernel ([B, max_pages]) — the signal for the
+    engine's low-attention-mass page eviction (mesh=None only: mass
+    over a head shard is chip-local, not replicated)."""
     if mesh is not None and ffn_factory is not None:
         raise ValueError(
             "tensor-parallel serving supports the dense Llama family "
             "only (MoE scales out on dp replicas)")
+    q4 = kv_bits == 4
+    quant = kv_int8 or q4
+    if kv_int8 and q4:
+        raise ValueError("kv_int8 and kv_bits=4 are exclusive")
+    if evict_mass and mesh is not None:
+        raise ValueError("attention-mass harvest requires mesh=None")
+    if evict_mass and (spec_gamma or fused_k > 1):
+        raise ValueError(
+            "attention-mass harvest rides the plain K=1 decode block "
+            "(spec/fused ticks have no single per-page mass signal)")
     tp = int(mesh.shape["tp"]) if mesh is not None else 1
     tp_axis = "tp" if mesh is not None else None
     lcfg = cfg if tp == 1 else replace(
@@ -663,19 +711,28 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         d0 = jnp.where(active, pos - tvec, 0)
         shape = pool["k"].shape            # [L, n_pages, Hkv, P, D]
         # the write buffer stays in the MODEL dtype regardless of the
-        # pool's (int8 pools quantize at flush, not at write — the
-        # in-block keys are attended exactly)
+        # pool's (int8/int4 pools quantize at flush, not at write — the
+        # in-block keys are attended exactly; a packed-int4 pool's last
+        # dim is D/2, so the buffer sizes off the config, not the pool)
         buf = {n: jnp.zeros((shape[0], n_slots, shape[2], stride,
-                             shape[4]), lcfg.jdtype)
+                             lcfg.head_dim), lcfg.jdtype)
                for n in ("k", "v")}
         bad0 = jnp.zeros(tokens.shape, bool)
+        macc0 = jnp.zeros((n_slots, max_pages), jnp.float32)
 
         def step(carry, xs):
-            tokens, pos, buf, bad = carry
+            tokens, pos, buf, bad, macc = carry
             j, k_ = xs
-            logits, buf = _paged_row_step(
-                params, tokens, pool, pt, tvec, tpad, d0, buf, pos, j,
-                lcfg, interpret, ffn=ffn, tp_axis=tp_axis)
+            if evict_mass:
+                logits, buf, pmass = _paged_row_step(
+                    params, tokens, pool, pt, tvec, tpad, d0, buf,
+                    pos, j, lcfg, interpret, ffn=ffn, tp_axis=tp_axis,
+                    collect_mass=True)
+                macc = macc + pmass
+            else:
+                logits, buf = _paged_row_step(
+                    params, tokens, pool, pt, tvec, tpad, d0, buf,
+                    pos, j, lcfg, interpret, ffn=ffn, tp_axis=tp_axis)
             # per-slot invalid-logit flag (slots are independent rows,
             # so a poisoned page NaNs exactly one row's logits — the
             # host quarantines that slot, never the batch)
@@ -683,12 +740,18 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             nxt = _pick(logits, temps, k_).astype(tokens.dtype)
             nxt = jnp.where(active, nxt, tokens)
             pos = jnp.where(active, pos + 1, pos)
-            return (nxt, pos, buf, bad), nxt
+            return (nxt, pos, buf, bad, macc), nxt
 
-        (tokens, pos, buf, bad), block = lax.scan(
-            step, (tokens, pos, buf, bad0), (jnp.arange(stride), keys))
+        (tokens, pos, buf, bad, macc), block = lax.scan(
+            step, (tokens, pos, buf, bad0, macc0),
+            (jnp.arange(stride), keys))
         pool = _flush_buffer_paged(pool, buf, pt, tpad, d0, page_size)
-        return block, tokens, pos, pool, bad.astype(jnp.int32)
+        outs = (block, tokens, pos, pool, bad.astype(jnp.int32))
+        if evict_mass:
+            # mean per-page attention mass over the block's steps —
+            # the eviction signal the host EMAs into _page_mass
+            outs = outs + (macc / stride,)
+        return outs
 
     def _pw_body(params, padded_prompts, true_lens, temps_w,
                  base_key, rid0):
@@ -733,22 +796,34 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                 "k_scale": ksc.reshape(cache_w["k"].shape[:-1]),
                 "v_scale": vsc.reshape(cache_w["v"].shape[:-1]),
             }
+        elif q4:
+            # per-group int4 over the whole panel at once (the bucket
+            # is a page multiple and kv_group | page_size, so groups
+            # never straddle the per-page copies below)
+            from kubegpu_tpu.ops.kvquant import quantize_groups_q4
+            kq, ksc = quantize_groups_q4(cache_w["k"], kv_group)
+            vq, vsc = quantize_groups_q4(cache_w["v"], kv_group)
+            cache_q = {"k": kq, "v": vq, "k_scale": ksc, "v_scale": vsc}
         for i in range(k):
             for pi in range(n_pages_row):
                 sl = (slice(None), slice(i, i + 1), slice(None),
                       slice(pi * page_size, (pi + 1) * page_size))
                 start = (0, page_dst[i, pi], 0, 0, 0)
-                if kv_int8:
+                if quant:
+                    gsz = 1 if kv_int8 else kv_group
+                    ssl = (slice(None), slice(i, i + 1), slice(None),
+                           slice(pi * page_size // gsz,
+                                 (pi + 1) * page_size // gsz))
                     pool = {
                         "k": lax.dynamic_update_slice(
                             pool["k"], cache_q["k"][sl], start),
                         "v": lax.dynamic_update_slice(
                             pool["v"], cache_q["v"][sl], start),
                         "k_scale": lax.dynamic_update_slice(
-                            pool["k_scale"], cache_q["k_scale"][sl],
+                            pool["k_scale"], cache_q["k_scale"][ssl],
                             start[:-1]),
                         "v_scale": lax.dynamic_update_slice(
-                            pool["v_scale"], cache_q["v_scale"][sl],
+                            pool["v_scale"], cache_q["v_scale"][ssl],
                             start[:-1]),
                     }
                 else:
@@ -802,6 +877,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             _chunk_causal_partials,
             _quantize_rows,
         )
+        from kubegpu_tpu.ops.kvquant import quantize_groups_q4
         from kubegpu_tpu.ops.paged_attention import (
             fold_chunk_queries,
             merge_partials,
@@ -818,7 +894,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
         zeros1 = jnp.zeros((1,), jnp.int32)
 
         def layer(x, xs):
-            if kv_int8:
+            if quant:
                 lp, pk, pv, pks, pvs = xs
             else:
                 lp, pk, pv = xs      # per-layer [n_pages, Hkv, P, D]
@@ -827,19 +903,29 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             if kv_int8:
                 kq, ksc = _quantize_rows(k)
                 vq, vsc = _quantize_rows(v)
+            elif q4:
+                # chunks are page-aligned and kv_group | page_size, so
+                # per-group quantization of the whole chunk never
+                # straddles the per-page writes below
+                kq, ksc = quantize_groups_q4(k, kv_group)
+                vq, vsc = quantize_groups_q4(v, kv_group)
             for j in range(c_pages):
                 pid = pt_row[0, page_base + j]
                 sl = (slice(None), slice(None),
                       slice(j * page_size, (j + 1) * page_size))
-                if kv_int8:
+                if quant:
+                    gsz = 1 if kv_int8 else kv_group
+                    ssl = (slice(None), slice(None),
+                           slice(j * page_size // gsz,
+                                 (j + 1) * page_size // gsz))
                     pk = lax.dynamic_update_slice(
                         pk, kq[sl], (pid, 0, 0, 0))
                     pv = lax.dynamic_update_slice(
                         pv, vq[sl], (pid, 0, 0, 0))
                     pks = lax.dynamic_update_slice(
-                        pks, ksc[sl], (pid, 0, 0))
+                        pks, ksc[ssl], (pid, 0, 0))
                     pvs = lax.dynamic_update_slice(
-                        pvs, vsc[sl], (pid, 0, 0))
+                        pvs, vsc[ssl], (pid, 0, 0))
                 else:
                     pk = lax.dynamic_update_slice(
                         pk, k[sl].astype(pk.dtype), (pid, 0, 0, 0))
@@ -851,19 +937,19 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             o_p, m_p, l_p = paged_attention(
                 qflat, pk[None], pv[None], pt_row, jnp.int32(0),
                 svec, svec, zeros1,
-                k_scale=pks[None] if kv_int8 else None,
-                v_scale=pvs[None] if kv_int8 else None,
+                k_scale=pks[None] if quant else None,
+                v_scale=pvs[None] if quant else None,
                 interpret=interpret)
             # the chunk's own keys attend EXACTLY (unquantized), the
             # same write-buffer-is-exact contract the decode block has
             o_c, m_c, l_c = _chunk_causal_partials(q, k, v)
             o = merge_partials(o_p, m_p, l_p, o_c, m_c, l_c)
             o = o.reshape(1, lcfg.n_heads, c, hd).astype(x.dtype)
-            new = (pk, pv, pks, pvs) if kv_int8 else (pk, pv)
+            new = (pk, pv, pks, pvs) if quant else (pk, pv)
             return _attn_finish(x, o, lp, lcfg, ffn,
                                 tp_axis=tp_axis), new
 
-        if kv_int8:
+        if quant:
             xs = (params["layers"], pool["k"], pool["v"],
                   pool["k_scale"], pool["v_scale"])
             x, (pk_new, pv_new, pks_new, pvs_new) = lax.scan(
@@ -961,6 +1047,10 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                 _chunk_causal_partials,
                 _quantize_rows,
             )
+            from kubegpu_tpu.ops.kvquant import (
+                dequantize_q4,
+                quantize_groups_q4,
+            )
             from kubegpu_tpu.ops.paged_attention import (
                 fold_chunk_queries,
                 merge_partials,
@@ -1004,8 +1094,48 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                 return lax.dynamic_update_slice(
                     pw, win[0:1], (pid0[r], 0, 0) + (0,) * len(tail))
 
+            def put_win_q4(pw, pws, seg, r):
+                """int4 twin of put_win, jointly over a packed value
+                leaf [n_pages, Hkv, P, D/2] and its group-scale leaf
+                [n_pages, Hkv, P/g]: dequantize row r's 2-page window,
+                splice the f32 segment at its (possibly group-
+                unaligned) offset, requantize the WHOLE window per
+                group.  Groups already at full int4 range requantize to
+                the same bytes, so the verify overwrite stays
+                idempotent after the first pass.  Same pid1-first
+                clamp-edge rule as put_win."""
+                gq = kv_group
+                w0 = lax.dynamic_slice(pw, (pid0[r], 0, 0, 0),
+                                       (1, hkv, p, hd // 2))
+                w1 = lax.dynamic_slice(pw, (pid1[r], 0, 0, 0),
+                                       (1, hkv, p, hd // 2))
+                s0 = lax.dynamic_slice(pws, (pid0[r], 0, 0),
+                                       (1, hkv, p // gq))
+                s1 = lax.dynamic_slice(pws, (pid1[r], 0, 0),
+                                       (1, hkv, p // gq))
+                win = jnp.concatenate([w0, w1], axis=0) \
+                    .transpose(1, 0, 2, 3).reshape(hkv, 2 * p, hd // 2)
+                sc = jnp.concatenate([s0, s1], axis=0) \
+                    .transpose(1, 0, 2).reshape(hkv, 2 * p // gq)
+                vals = dequantize_q4(win, sc, gq)
+                vals = lax.dynamic_update_slice(
+                    vals, seg.astype(vals.dtype), (0, off[r], 0))
+                wq, wsc = quantize_groups_q4(vals, gq)
+                wq = wq.reshape(hkv, 2, p, hd // 2) \
+                    .transpose(1, 0, 2, 3)
+                wsc = wsc.reshape(hkv, 2, p // gq).transpose(1, 0, 2)
+                pw = lax.dynamic_update_slice(
+                    pw, wq[1:2], (pid1[r], 0, 0, 0))
+                pw = lax.dynamic_update_slice(
+                    pw, wq[0:1], (pid0[r], 0, 0, 0))
+                pws = lax.dynamic_update_slice(
+                    pws, wsc[1:2], (pid1[r], 0, 0))
+                pws = lax.dynamic_update_slice(
+                    pws, wsc[0:1], (pid0[r], 0, 0))
+                return pw, pws
+
             def layer(x, xs):
-                if kv_int8:
+                if quant:
                     lp, pk, pv, pks, pvs = xs
                 else:
                     lp, pk, pv = xs
@@ -1022,10 +1152,15 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                                 put_win(pv, vq[r], r),
                                 put_win(pks, ksc[r], r),
                                 put_win(pvs, vsc[r], r))
+                    if q4:
+                        pk, pv, pks, pvs = st
+                        pk, pks = put_win_q4(pk, pks, k[r], r)
+                        pv, pvs = put_win_q4(pv, pvs, v[r], r)
+                        return (pk, pv, pks, pvs)
                     pk, pv = st
                     return put_win(pk, k[r], r), put_win(pv, v[r], r)
 
-                st = (pk, pv, pks, pvs) if kv_int8 else (pk, pv)
+                st = (pk, pv, pks, pvs) if quant else (pk, pv)
                 st = lax.fori_loop(0, n_slots, wrow, st)
                 # validity stops at d0, so the kernel never reads the
                 # entries just written — the chunk's own keys attend
@@ -1033,8 +1168,8 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                 o_p, m_p, l_p = paged_attention(
                     fold_chunk_queries(q), st[0][None], st[1][None],
                     pt, jnp.int32(0), tvec, tpad, d0,
-                    k_scale=st[2][None] if kv_int8 else None,
-                    v_scale=st[3][None] if kv_int8 else None,
+                    k_scale=st[2][None] if quant else None,
+                    v_scale=st[3][None] if quant else None,
                     interpret=interpret)
                 o_c, m_c, l_c = _chunk_causal_partials(q, k, v)
                 o = merge_partials(o_p, m_p, l_p, o_c, m_c, l_c)
@@ -1042,7 +1177,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
                 return _attn_finish(x, o, lp, lcfg, ffn,
                                     tp_axis=tp_axis), st
 
-            if kv_int8:
+            if quant:
                 xs = (params["layers"], pool["k"], pool["v"],
                       pool["k_scale"], pool["v_scale"])
                 x, (pk, pv, pks, pvs) = lax.scan(layer, x, xs)
@@ -1090,7 +1225,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
             d0 = jnp.where(active, pos - tvec, 0)
             shape = pool["k"].shape
             dbuf = {n: jnp.zeros((draft_layers, n_slots, shape[2],
-                                  gamma, shape[4]), lcfg.jdtype)
+                                  gamma, lcfg.head_dim), lcfg.jdtype)
                     for n in ("k", "v")}
 
             def dstep(carry, i):
@@ -1255,7 +1390,7 @@ def _paged_engine_fns(cfg: LlamaConfig, n_slots: int, max_pages: int,
     rep = P()
     kvspec = P(None, None, "tp", None, None)
     pool_spec = {"k": kvspec, "v": kvspec}
-    if kv_int8:
+    if quant:
         pool_spec.update(k_scale=P(None, None, "tp", None),
                          v_scale=P(None, None, "tp", None))
     cache_spec = {"k": kvspec, "v": kvspec}   # prefill panel: model dtype
@@ -1499,7 +1634,11 @@ class ContinuousBatcher:
                  sampling: bool = False, top_k: int = 0, seed: int = 0,
                  max_wave: int = 8, paged: bool = False,
                  page_size: int = 128, total_pages: int | None = None,
-                 kv_int8: bool = False, prefix_cache: bool = False,
+                 kv_int8: bool = False, kv_bits: int | None = None,
+                 kv_group: int | None = None,
+                 evict_policy: str | None = None,
+                 evict_param: float | None = None,
+                 prefix_cache: bool = False,
                  chunked_prefill: bool = False,
                  prefill_chunk: int | None = None,
                  metrics=None, mesh=None,
@@ -1639,6 +1778,74 @@ class ContinuousBatcher:
             raise ValueError(
                 "kv_int8=True requires paged=True (the dense engine's "
                 "int8 cache is the static decode path's kv_int8)")
+        # -- KV bit-width (ISSUE 15): ``kv_bits`` generalizes kv_int8.
+        # 16 = model dtype, 8 = the int8 per-token pool (alias for
+        # kv_int8=True), 4 = PACKED int4 pages with one f32 scale per
+        # ``kv_group`` tokens.  The group must divide both stride and
+        # page_size so every non-speculative pool write lands
+        # group-aligned — that alignment is what keeps int4 writes
+        # deterministic and exactly-once under chaos replay.
+        if kv_bits is None:
+            kv_bits = 8 if kv_int8 else 16
+        if kv_bits not in (16, 8, 4):
+            raise ValueError(f"kv_bits {kv_bits} not in (16, 8, 4)")
+        if kv_bits == 8:
+            if not paged:
+                raise ValueError("kv_bits=8 requires paged=True")
+            kv_int8 = True
+        if kv_bits == 4:
+            if kv_int8:
+                raise ValueError(
+                    "kv_int8=True and kv_bits=4 are exclusive — pick "
+                    "one pool quantization")
+            if not paged:
+                raise ValueError(
+                    "kv_bits=4 requires paged=True (the packed int4 "
+                    "format is a page-pool layout)")
+            if cfg.head_dim % 2:
+                raise ValueError(
+                    f"kv_bits=4 needs an even head_dim, got "
+                    f"{cfg.head_dim} (two channels pack per byte)")
+            kv_group = int(kv_group) if kv_group else stride
+            if stride % kv_group or page_size % kv_group:
+                raise ValueError(
+                    f"kv_group {kv_group} must divide both stride "
+                    f"{stride} and page_size {page_size} (group-"
+                    "aligned writes are the exactly-once contract)")
+        else:
+            if kv_group:
+                raise ValueError("kv_group only applies to kv_bits=4")
+            kv_group = 0
+        self.kv_bits = int(kv_bits)
+        self.kv_group = int(kv_group)
+        # -- attention-aware page eviction (ISSUE 15) ------------------
+        # ``evict_policy``: "window" drops prompt pages wholly below
+        # the trailing ``evict_param``-token window; "mass" drops the
+        # lowest attention-mass prompt pages (EMA of the per-page mass
+        # the decode kernel harvests) once their mass falls below
+        # ``evict_param``.  Both release pages through the standing
+        # refcount machinery and punch a page-id-0 HOLE in the slot's
+        # table row — the kernel's validity mask skips holes.
+        if evict_policy is not None:
+            if evict_policy not in ("window", "mass"):
+                raise ValueError(
+                    f"evict_policy {evict_policy!r} not in "
+                    "('window', 'mass')")
+            if not paged:
+                raise ValueError("evict_policy requires paged=True")
+            if mesh is not None:
+                raise ValueError(
+                    "evict_policy requires mesh=None (the mass signal "
+                    "is a chip-local head-shard statistic)")
+            if spec_gamma or fused_ticks > 1:
+                raise ValueError(
+                    "evict_policy rides the plain K=1 decode path "
+                    "(spec/fused blocks have no per-tick mass signal)")
+            if evict_param is None:
+                evict_param = (2.0 * page_size
+                               if evict_policy == "window" else 0.02)
+        self.evict_policy = evict_policy
+        self.evict_param = float(evict_param or 0.0)
         if (prefix_cache or chunked_prefill) and not paged:
             raise ValueError(
                 "prefix_cache / chunked_prefill require paged=True — "
@@ -1678,6 +1885,8 @@ class ContinuousBatcher:
             self._fns = _paged_engine_fns(
                 cfg, n_slots, self.max_pages, page_size, stride, top_k,
                 sampling, interpret, kv_int8,
+                kv_bits=self.kv_bits, kv_group=self.kv_group,
+                evict_mass=(evict_policy == "mass"),
                 ffn_factory=ffn_factory, ffn_cfg=ffn_cfg, mesh=mesh,
                 quant_weights=quant_weights,
                 spec_gamma=self.spec_gamma,
@@ -1697,6 +1906,20 @@ class ContinuousBatcher:
                              "v": jnp.zeros(shape, jnp.int8),
                              "k_scale": jnp.ones(shape[:-1], jnp.float32),
                              "v_scale": jnp.ones(shape[:-1], jnp.float32)}
+            elif self.kv_bits == 4:
+                # packed int4: two channels per byte, one f32 scale
+                # per kv_group tokens.  Q4_ZERO_BYTE puts both nibbles
+                # at the bias so an unwritten page dequantizes to
+                # exact zero under ANY scale — the int4 twin of the
+                # int8 pool's scale-1 init.
+                from kubegpu_tpu.ops.kvquant import Q4_ZERO_BYTE
+                pshape = shape[:-1] + (cfg.head_dim // 2,)
+                sshape = shape[:-1][:-1] + (page_size // self.kv_group,)
+                self.pool = {
+                    "k": jnp.full(pshape, Q4_ZERO_BYTE, jnp.uint8),
+                    "v": jnp.full(pshape, Q4_ZERO_BYTE, jnp.uint8),
+                    "k_scale": jnp.ones(sshape, jnp.float32),
+                    "v_scale": jnp.ones(sshape, jnp.float32)}
             else:
                 self.pool = {"k": jnp.zeros(shape, cfg.jdtype),
                              "v": jnp.zeros(shape, cfg.jdtype)}
@@ -1773,6 +1996,13 @@ class ContinuousBatcher:
             # bound; maintained wherever _slot_pages/_tpad are
             self._cap = np.zeros((n_slots,), np.int32)
             self._cap_dev = None
+            # per-(slot, page-index) EMA of the decode kernel's
+            # attention-mass harvest + the device array holding the
+            # not-yet-fetched mass of the in-flight block (read in
+            # _maybe_evict AFTER the tick's main sync, so it costs no
+            # extra device round trip)
+            self._page_mass = np.zeros((n_slots, self.max_pages))
+            self._mass_pending = None
         else:
             self._fns = _engine_fns(cfg, n_slots, self.max_len, stride,
                                     top_k, sampling,
@@ -1853,6 +2083,14 @@ class ContinuousBatcher:
         self.pages_aliased = 0
         self.prefix_hits = 0         # admissions that aliased >= 1 page
         self.chunks_run = 0          # prefill chunks dispatched
+        # KV compression & eviction accounting (ISSUE 15): pages the
+        # eviction policy released, and the bench-measured quality
+        # delta vs a bf16 reference (note_kv_quality sets it)
+        self.pages_evicted = 0
+        self.kv_quality_delta = 0.0
+        if metrics is not None:
+            metrics.set_gauge("serve_kv_bits",
+                              self.kv_bits if paged else 16)
         # per-tick decode stall: host wall of the tick's admission +
         # prefill-chunk work (a lower-bound proxy under async dispatch;
         # the bench computes the device-anchored version from
@@ -2993,7 +3231,8 @@ class ContinuousBatcher:
         if not victims:
             return []
         if self.paged and need_pages > self._available_pages() + sum(
-                len(self._slot_pages.get(s, ())) for s, _ in victims):
+                sum(1 for p in self._slot_pages.get(s, ()) if p)
+                for s, _ in victims):
             return []
         freed: list[int] = []
         for s, r in victims:
@@ -3303,11 +3542,16 @@ class ContinuousBatcher:
                 [emit.reshape(-1), take, matched, badv,
                  self.first_toks])
         elif self.paged:
-            block, self.tokens, self.pos, self.pool, bad = self._fns[0](
+            outs = self._fns[0](
                 self.params, self.pool, self._pt_dev,
                 self._tvec_dev, self._tpad_dev,
                 self.tokens, self.pos, self._active_mask(),
                 self.temps, self._base_key, jnp.int32(self._tick))
+            if self.evict_policy == "mass":
+                (block, self.tokens, self.pos, self.pool, bad,
+                 self._mass_pending) = outs
+            else:
+                block, self.tokens, self.pos, self.pool, bad = outs
             self._inflight_spec = False
             self._inflight_kind = "block"
             self._inflight = jnp.concatenate(
@@ -3409,6 +3653,8 @@ class ContinuousBatcher:
             self._expire_deadlines(finished)
             t_adm = time.perf_counter()
             self._tick_work = []
+            if self.paged and self.evict_policy is not None:
+                self._maybe_evict()
             self._admit()
             if self.paged:
                 self._run_prefill_chunks()
@@ -3926,6 +4172,8 @@ class ContinuousBatcher:
         if not self.paged:
             return
         for p in self._slot_pages.pop(slot, []):
+            if p == 0:
+                continue          # eviction hole — already released
             self._page_refs[p] -= 1
             if self._page_refs[p] == 0 and p not in self._page_key:
                 del self._page_refs[p]
@@ -3934,7 +4182,90 @@ class ContinuousBatcher:
         self._tvec[slot] = 0
         self._tpad[slot] = 0
         self._cap[slot] = 0
+        if self.evict_policy is not None:
+            self._page_mass[slot] = 0.0
         self._mark_tables_dirty(slot)
+
+    # -- attention-aware page eviction (ISSUE 15 tentpole) --------------
+
+    def _maybe_evict(self) -> None:
+        """Drop cold PROMPT pages from fully-admitted decoding slots.
+
+        ``window``: a prompt page wholly below the trailing
+        ``evict_param``-token window of the prompt is dropped;
+        ``mass``: the decode kernel's per-page attention-mass harvest
+        (EMA 0.8/0.2 across ticks) marks pages whose mass fell below
+        ``evict_param``.  Either way the page releases through the
+        standing refcount machinery and its table entry becomes a
+        page-id-0 HOLE the kernels' validity masks skip — positions
+        keep their rope phases, the page just stops being attended
+        (and its HBM goes back to the allocator).
+
+        Safety rails: never the first prompt page (the attention
+        sink), never a shared page (refcount > 1 — an aliased prefix
+        is some other slot's live context), never a prefix-registered
+        page, never a slot that is still prefilling / awaiting its
+        first token / exporting a migration chain, and at least two
+        real prompt pages always remain."""
+        if self.evict_policy == "mass" and self._mass_pending is not None:
+            # the block carrying this mass was synced in _collect, so
+            # this fetch is a device->host copy of a READY array
+            mass = np.asarray(self._mass_pending)
+            self._mass_pending = None
+            live = self.active & np.isfinite(mass).all(axis=1)
+            self._page_mass[live] = (0.8 * self._page_mass[live]
+                                     + 0.2 * mass[live])
+        p = self.page_size
+        for slot, req in list(self.slot_req.items()):
+            if (slot in self._prefilling or slot in self._await_first
+                    or req.rid in self._migrate_out
+                    or not self.active[slot]):
+                continue
+            n_prompt = int(self._tpad[slot]) // p
+            if n_prompt <= 2:
+                continue
+            row = self._pt[slot]
+            live_idx = [pi for pi in range(n_prompt) if row[pi] != 0]
+            if self.evict_policy == "window":
+                t = int(self._tvec[slot])
+                horizon = t - int(self.evict_param)
+                cand = [pi for pi in live_idx
+                        if pi >= 1 and (pi + 1) * p <= horizon]
+            else:
+                cand = sorted(
+                    (pi for pi in live_idx
+                     if pi >= 1
+                     and self._page_mass[slot, pi] < self.evict_param),
+                    key=lambda pi: self._page_mass[slot, pi])
+            remaining = len(live_idx)
+            for pi in cand:
+                if remaining <= 2:
+                    break
+                page = int(row[pi])
+                if (self._page_refs.get(page, 0) != 1
+                        or page in self._page_key):
+                    continue    # shared or prefix-retained: keep
+                self._pt[slot, pi] = 0
+                self._slot_pages[slot][pi] = 0
+                del self._page_refs[page]
+                self._free_pages.append(page)
+                self._page_mass[slot, pi] = 0.0
+                self._mark_tables_dirty(slot)
+                self.pages_evicted += 1
+                remaining -= 1
+                if self._metrics is not None:
+                    self._metrics.inc("serve_pages_evicted_total")
+
+    def note_kv_quality(self, delta: float) -> None:
+        """Record the measured KV-compression quality delta — the
+        fraction of greedy tokens that diverge from a bf16 reference
+        engine over the same workload.  The bench measures it (the
+        engine cannot see its own counterfactual); the engine owns
+        the ``serve_kv_quality_delta`` gauge."""
+        self.kv_quality_delta = float(delta)
+        if self._metrics is not None:
+            self._metrics.set_gauge("serve_kv_quality_delta",
+                                    round(float(delta), 6))
 
     def drain(self, max_ticks: int = 10_000) -> list[_Request]:
         """Run until queue and slots are empty; returns every finished
@@ -3998,9 +4329,10 @@ class ContinuousBatcher:
                  f"{sorted((set(self._free_pages) | allocated) - universe)}")
         owners: dict[int, int] = {}
         for slot, pages in self._slot_pages.items():
-            if len(pages) != len(set(pages)):
+            real = [p for p in pages if p]   # 0 = eviction hole
+            if len(real) != len(set(real)):
                 fail(f"slot {slot} references a page twice")
-            for p in pages:
+            for p in real:
                 owners[p] = owners.get(p, 0) + 1
         for p in allocated:
             if self._page_refs[p] != owners.get(p, 0):

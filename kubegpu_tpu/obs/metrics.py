@@ -50,6 +50,14 @@ name                            kind       meaning
                                            mirror (ISSUE 13)
 ``serving_deadline_miss``       gauge      pod-harvested deadline-miss
                                            mirror (ISSUE 13)
+``serving_kv_bits``             gauge      pod-harvested KV element
+                                           width mirror, from
+                                           ``serve_kv_bits`` (ISSUE 15)
+``serving_pages_evicted_total``  gauge     pod-harvested context-
+                                           eviction mirror (ISSUE 15)
+``serving_kv_quality_delta``    gauge      pod-harvested kv-compression
+                                           quality-delta mirror
+                                           (ISSUE 15)
 ==============================  =========  ============================
 
 Serving engine (observed by ``ContinuousBatcher`` /
@@ -191,6 +199,22 @@ name                            kind       meaning
 ``serve_replicas_active``       gauge      live replicas in the pool
                                            after deaths, retires, and
                                            scale-ups (ISSUE 14)
+``serve_kv_bits``               gauge      KV-pool element width in
+                                           bits (16 = bf16, 8 = per-
+                                           token int8, 4 = grouped
+                                           packed int4; ISSUE 15)
+``serve_pages_evicted_total``   counter    resident KV pages dropped by
+                                           the context-eviction policy
+                                           (window or attention-mass;
+                                           ISSUE 15)
+``serve_kv_quality_delta``      gauge      measured greedy-token
+                                           disagreement vs the bf16
+                                           reference for the active
+                                           kv format (set by the
+                                           ``cb_kv_capacity`` bench /
+                                           serve harness via
+                                           ``note_kv_quality``;
+                                           ISSUE 15)
 ==============================  =========  ============================
 
 Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as

@@ -1,0 +1,84 @@
+"""Shared KV-cache quantization helpers: int8 per-token rows and the
+int4 per-group page format.
+
+One module owns the quantizer math for every KV representation in the
+tree — the dense int8 cache (:func:`kubegpu_tpu.models.decode`), the
+paged int8 pool write paths (:mod:`kubegpu_tpu.models.serve`), and the
+packed int4 pool (ISSUE 15) — so the dense and paged paths can never
+drift on rounding or scale conventions.
+
+int8 (``quantize_rows``): symmetric per-token scales over the channel
+dim — values in [-127, 127], ``scale = amax/127`` (1.0 for all-zero
+rows so unwritten cache slots dequantize to exact zero).
+
+int4 (``quantize_groups_q4`` / ``dequantize_q4``): two nibbles per
+byte along the channel dim — byte ``d`` packs channel ``d`` (low
+nibble) and channel ``d + D/2`` (high nibble), each the biased value
+``q + 8`` with ``q ∈ [-7, 7]`` — plus ONE f32 scale per GROUP of ``g``
+consecutive tokens (``scale = amax/7`` over the whole [g, D] tile).
+``Q4_ZERO_BYTE`` (0x88) is the all-zero pattern: both nibbles sit at
+the bias, so a pool initialized to it dequantizes to exact zero under
+any scale — the int4 twin of the int8 pool's scale-1 init.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Q4_BIAS = 8          # stored nibble = q + BIAS, q in [-7, 7]
+Q4_ZERO_BYTE = 0x88  # both nibbles at the bias -> dequantizes to 0
+
+
+def quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(..., token) symmetric int8 over the channel dim.
+    x: [..., T, D] → (int8 values, f32 scales [..., T])."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def q4_pack(q: jax.Array) -> jax.Array:
+    """Integer values in [-7, 7], shape [..., D] → packed uint8
+    [..., D//2]: byte ``d`` = channel ``d`` (low nibble) | channel
+    ``d + D/2`` (high nibble), both biased by :data:`Q4_BIAS`."""
+    d = q.shape[-1]
+    lo = (q[..., : d // 2] + Q4_BIAS).astype(jnp.uint8)
+    hi = (q[..., d // 2:] + Q4_BIAS).astype(jnp.uint8)
+    return lo | (hi << 4)
+
+
+def q4_unpack(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`q4_pack`: uint8 [..., D//2] → int8 [..., D].
+    The low-nibble half lands in channels [0, D/2), the high-nibble
+    half in [D/2, D) — a lane-dim concatenation, which is also the
+    Mosaic-safe way the pallas kernel unpacks in VMEM."""
+    lo = (packed & 0xF).astype(jnp.int8) - Q4_BIAS
+    hi = (packed >> 4).astype(jnp.int8) - Q4_BIAS
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+def quantize_groups_q4(x: jax.Array, g: int
+                       ) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int4 with one scale per group of ``g`` consecutive
+    tokens (axis -2).  x: [..., T, D] (T divisible by g, D even) →
+    (packed uint8 [..., T, D//2], f32 scales [..., T//g])."""
+    lead, t_, d_ = x.shape[:-2], x.shape[-2], x.shape[-1]
+    xf = x.astype(jnp.float32).reshape(lead + (t_ // g, g, d_))
+    amax = jnp.max(jnp.abs(xf), axis=(-1, -2))
+    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None, None]), -7, 7)
+    q = q.astype(jnp.int32).reshape(lead + (t_, d_))
+    return q4_pack(q), scale
+
+
+def dequantize_q4(packed: jax.Array, scales: jax.Array,
+                  g: int) -> jax.Array:
+    """packed uint8 [..., T, D//2] + f32 scales [..., T//g] →
+    f32 values [..., T, D]."""
+    q = q4_unpack(packed).astype(jnp.float32)
+    lead, t_, d_ = q.shape[:-2], q.shape[-2], q.shape[-1]
+    q = q.reshape(lead + (t_ // g, g, d_)) * scales[..., None, None]
+    return q.reshape(lead + (t_, d_))

@@ -59,6 +59,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kubegpu_tpu.ops.flash_attention import NEG_INF
+from kubegpu_tpu.ops.kvquant import q4_unpack
 
 # m/l partials ride in [B, Hq, LSE_LANES] tiles (value broadcast across
 # the lane dim) — same trick as flash_attention's lse: a full size-8
@@ -83,11 +84,12 @@ def decode_capacity(n_pages: int, t_pad: int, page_size: int) -> int:
 def gather_pages(pool: dict, page_ids: jax.Array) -> dict:
     """Fetch the listed pages from every pool leaf — the KV transfer
     unit for cross-engine page migration.  Works on the bf16 2-leaf
-    pool and the int8 QTensor 4-leaf pool alike: the page axis is
-    axis 1 on both the [L, pages, Hkv, P, D] value leaves and the
-    [L, pages, Hkv, P] scale leaves, so quantization scales travel
-    with their values.  Padding ids (0) gather the trash page, which
-    is never attended."""
+    pool, the int8 QTensor 4-leaf pool, and the packed-int4 pool
+    alike: the page axis is axis 1 on the [L, pages, Hkv, P, D] (or
+    packed [L, pages, Hkv, P, D/2]) value leaves and the per-token or
+    per-group scale leaves, so quantization scales travel with their
+    values.  Padding ids (0) gather the trash page, which is never
+    attended."""
     return {name: jnp.take(leaf, page_ids, axis=1)
             for name, leaf in pool.items()}
 
@@ -110,17 +112,24 @@ def paged_attention_ref(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
                         page_table: jax.Array, layer: jax.Array,
                         t: jax.Array, t_pad: jax.Array, d: jax.Array,
                         k_scale: jax.Array | None = None,
-                        v_scale: jax.Array | None = None
-                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
+                        v_scale: jax.Array | None = None,
+                        collect_mass: bool = False):
     """Gather-based reference.  q: [B, Hq, D]; pool: [L, n_pages, Hkv,
     P, D]; page_table: [B, max_pages] int32; layer: scalar int32;
     t/t_pad/d: [B] int32.  With ``k_scale``/``v_scale``
     ([L, n_pages, Hkv, P] f32 per-token scales) the pool holds int8
     values and the scales fold into the score/probability matrices —
     the same folding the dense int8 cache uses
-    (:func:`kubegpu_tpu.models.decode._cached_attend_q8`).  Returns
+    (:func:`kubegpu_tpu.models.decode._cached_attend_q8`).  A uint8
+    ``pool_k`` means packed int4 pages ([L, n_pages, Hkv, P, D/2],
+    see :mod:`kubegpu_tpu.ops.kvquant`) with per-GROUP scales
+    ([L, n_pages, Hkv, P/g]) — same folding, the group scale simply
+    broadcasts over its g tokens.  Page-table entry 0 masks out (the
+    trash page doubles as the eviction hole marker).  Returns
     (o [B, Hq, D] f32 normalized, m [B, Hq] f32, l [B, Hq] f32) — the
-    same partials the kernel emits."""
+    same partials the kernel emits — plus, when ``collect_mass``, the
+    per-page normalized attention mass [B, max_pages] (mean over query
+    heads, so each row sums to ≤ 1)."""
     b, hq, dd = q.shape
     hkv, p = pool_k.shape[2], pool_k.shape[3]
     g = hq // hkv
@@ -128,35 +137,48 @@ def paged_attention_ref(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
     s_len = max_pages * p
     kl = jnp.take(pool_k, layer, axis=0)     # [n_pages, Hkv, P, D]
     vl = jnp.take(pool_v, layer, axis=0)
+    if pool_k.dtype == jnp.uint8:            # packed int4 pages
+        kl = q4_unpack(kl)
+        vl = q4_unpack(vl)
     # [B, max_pages, Hkv, P, D] → [B, Hkv, S, D]
     k = jnp.take(kl, page_table, axis=0).transpose(0, 2, 1, 3, 4) \
         .reshape(b, hkv, s_len, dd)
     v = jnp.take(vl, page_table, axis=0).transpose(0, 2, 1, 3, 4) \
         .reshape(b, hkv, s_len, dd)
+
+    def scales_per_token(sc):
+        st = jnp.take(jnp.take(sc, layer, axis=0), page_table,
+                      axis=0).transpose(0, 2, 1, 3).reshape(b, hkv, -1)
+        if st.shape[-1] != s_len:   # int4 group scales → per token
+            st = jnp.repeat(st, s_len // st.shape[-1], axis=-1)
+        return st
+
     qg = q.reshape(b, hkv, g, dd)
     s = jnp.einsum("bkgd,bksd->bkgs", qg, k.astype(q.dtype),
                    preferred_element_type=jnp.float32) * (dd ** -0.5)
     if k_scale is not None:
-        ks = jnp.take(jnp.take(k_scale, layer, axis=0), page_table,
-                      axis=0).transpose(0, 2, 1, 3).reshape(b, hkv, s_len)
-        s = s * ks[:, :, None, :]
+        s = s * scales_per_token(k_scale)[:, :, None, :]
     phys = jnp.arange(s_len)[None, :]
     valid = ((phys < t[:, None])
              | ((phys >= t_pad[:, None]) & (phys < (t_pad + d)[:, None])))
+    valid = valid & (jnp.repeat(page_table, p, axis=1) != 0)
     s = jnp.where(valid[:, None, None, :], s, NEG_INF)
     m = jnp.max(s, axis=-1)                               # [B, Hkv, G]
     w = jnp.where(valid[:, None, None, :],
                   jnp.exp(s - m[..., None]), 0.0)
     l = jnp.sum(w, axis=-1)
+    if collect_mass:
+        wn = w / jnp.maximum(l, 1e-30)[..., None]
+        mass = wn.reshape(b, hkv, g, max_pages, p) \
+            .sum(axis=(1, 2, 4)) / hq
     if v_scale is not None:
-        vs = jnp.take(jnp.take(v_scale, layer, axis=0), page_table,
-                      axis=0).transpose(0, 2, 1, 3).reshape(b, hkv, s_len)
-        w = w * vs[:, :, None, :]
+        w = w * scales_per_token(v_scale)[:, :, None, :]
         v = v.astype(q.dtype)
     o = jnp.einsum("bkgs,bksd->bkgd", w.astype(v.dtype), v,
                    preferred_element_type=jnp.float32)
     o = o / jnp.maximum(l, 1e-30)[..., None]
-    return (o.reshape(b, hq, dd), m.reshape(b, hq), l.reshape(b, hq))
+    out = (o.reshape(b, hq, dd), m.reshape(b, hq), l.reshape(b, hq))
+    return out + (mass,) if collect_mass else out
 
 
 def fold_chunk_queries(q: jax.Array) -> jax.Array:
@@ -197,10 +219,16 @@ def merge_partials(o1: jax.Array, m1: jax.Array, l1: jax.Array,
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
+def _mass_onehot(rl, mp_pad):
+    """[1, 1, mp_pad] f32 indicator of row-local page ``rl`` — the
+    accumulate target for the per-page attention-mass harvest."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, mp_pad), 2)
+    return (iota == rl).astype(jnp.float32)
+
+
 def _paged_kernel(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
                   q_ref, pk_ref, pv_ref,
-                  o_ref, m_ref, l_ref,
-                  kbuf, vbuf, sems):
+                  *refs, collect_mass=False):
     """One grid program per ROW; the program loops over the row's USED
     pages with double-buffered manual DMAs from the HBM-resident pool.
 
@@ -213,9 +241,28 @@ def _paged_kernel(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
       pages are never fetched — reads scale with what the row actually
       holds, which is how the paged engine out-reads the dense cache.
 
+    Page-table entry 0 additionally masks out: the trash page doubles
+    as the EVICTION HOLE marker (ISSUE 15), so a dropped context page
+    vanishes from the softmax without renumbering the row.  For rows
+    that never evict this predicate is vacuous — allocated pages are
+    never page 0 — so non-evicting configs stay bit-exact.
+
+    With ``collect_mass`` (static) the kernel also emits the per-page
+    normalized attention mass ([1, mp_pad] per row): sum(w) per page
+    accumulated in the carry with the same alpha rescale as ``l``,
+    normalized by l and averaged over query heads at the end — the
+    accumulator the engine's low-attention-mass eviction policy reads.
+
     Grouped [Hkv, G, ·] layout end-to-end: q arrives pre-grouped and
     outputs leave grouped (Mosaic rejects in-kernel shape casts that
     split/merge sublane dims, e.g. (16,128)→(4,4,128))."""
+    if collect_mass:
+        o_ref, m_ref, l_ref, mass_ref, kbuf, vbuf, sems = refs
+        mp_pad = mass_ref.shape[1]
+    else:
+        o_ref, m_ref, l_ref, kbuf, vbuf, sems = refs
+        mass_ref = None
+        mp_pad = 0
     b = pl.program_id(0)
     hkv, g, dd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     p = kbuf.shape[2]
@@ -241,12 +288,12 @@ def _paged_kernel(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
                 pltpu.make_async_copy(pv_ref.at[layer, pid],
                                       vbuf.at[slot], sems.at[slot, 1]))
 
-    def run(acc, m_i, l_i):
+    def run(carry0):
         for d_ in dma_pair(0, 0):
             d_.start()
 
         def body(i, carry):
-            acc, m_prev, l_prev = carry
+            acc, m_prev, l_prev, macc = carry
             slot = jax.lax.rem(i, 2)
 
             @pl.when(i + 1 < n_used)
@@ -261,9 +308,11 @@ def _paged_kernel(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
             s = jax.lax.dot_general(
                 q_ref[0], k, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32) * (dd ** -0.5)
+            pid = pt_ref[b, rl_page(i)]
             phys = (rl_page(i) * p
                     + jax.lax.broadcasted_iota(jnp.int32, (1, 1, p), 2))
-            valid = (phys < tb) | ((phys >= tpb) & (phys < tpb + db))
+            valid = (((phys < tb) | ((phys >= tpb) & (phys < tpb + db)))
+                     & (pid != 0))
             s = jnp.where(valid, s, NEG_INF)
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
             # NEG_INF is a finite sentinel: exp(s - m_new) would be
@@ -274,31 +323,46 @@ def _paged_kernel(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
             pv_ = jax.lax.dot_general(
                 w.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32)    # [Hkv, G, D]
-            return acc * alpha[..., None] + pv_, m_new, l_new
+            if collect_mass:
+                macc = (macc * alpha[..., None]
+                        + jnp.sum(w, axis=-1)[..., None]
+                        * _mass_onehot(rl_page(i), mp_pad))
+            return acc * alpha[..., None] + pv_, m_new, l_new, macc
 
-        return jax.lax.fori_loop(0, n_used, body, (acc, m_i, l_i))
+        return jax.lax.fori_loop(0, n_used, body, carry0)
 
     acc0 = jnp.zeros((hkv, g, dd), jnp.float32)
     m0 = jnp.full((hkv, g), NEG_INF, jnp.float32)
     l0 = jnp.zeros((hkv, g), jnp.float32)
-    acc, m_f, l_f = run(acc0, m0, l0)
+    macc0 = jnp.zeros((hkv, g, max(mp_pad, 1)), jnp.float32)
+    acc, m_f, l_f, macc = run((acc0, m0, l0, macc0))
     norm = jnp.maximum(l_f, 1e-30)[..., None]
     o_ref[0] = acc / norm
     m_ref[0] = jnp.broadcast_to(m_f[..., None], (hkv, g, LSE_LANES))
     l_ref[0] = jnp.broadcast_to(l_f[..., None], (hkv, g, LSE_LANES))
+    if collect_mass:
+        mass_ref[0] = jnp.sum(macc / norm, axis=(0, 1)) / (hkv * g)
 
 
 def _paged_kernel_q8(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
                      q_ref, pk_ref, pv_ref, pks_ref, pvs_ref,
-                     o_ref, m_ref, l_ref,
-                     kbuf, vbuf, ksbuf, vsbuf, sems):
+                     *refs, collect_mass=False):
     """int8-pool variant of :func:`_paged_kernel`: pages hold int8 K/V
     with per-token f32 scales ([L, n_pages, Hkv, P]); the scales fold
     into the score matrix (k) and the probability matrix (v) exactly
     as the dense int8 cache's ``_cached_attend_q8`` does, and the
     cache streams from HBM at HALF the bytes — the lever that made
     wide-batch dense decode 1.6x (r2).  Same DMA structure with two
-    extra (tiny) scale-page copies per step."""
+    extra (tiny) scale-page copies per step; same hole masking and
+    optional mass harvest as :func:`_paged_kernel`."""
+    if collect_mass:
+        o_ref, m_ref, l_ref, mass_ref, kbuf, vbuf, ksbuf, vsbuf, \
+            sems = refs
+        mp_pad = mass_ref.shape[1]
+    else:
+        o_ref, m_ref, l_ref, kbuf, vbuf, ksbuf, vsbuf, sems = refs
+        mass_ref = None
+        mp_pad = 0
     b = pl.program_id(0)
     hkv, g, dd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     p = kbuf.shape[2]
@@ -323,12 +387,12 @@ def _paged_kernel_q8(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
                 pltpu.make_async_copy(pvs_ref.at[layer, pid],
                                       vsbuf.at[slot], sems.at[slot, 3]))
 
-    def run(acc, m_i, l_i):
+    def run(carry0):
         for d_ in dma_quad(0, 0):
             d_.start()
 
         def body(i, carry):
-            acc, m_prev, l_prev = carry
+            acc, m_prev, l_prev, macc = carry
             slot = jax.lax.rem(i, 2)
 
             @pl.when(i + 1 < n_used)
@@ -347,9 +411,11 @@ def _paged_kernel_q8(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
                 qv, k, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32) * (dd ** -0.5)
             s = s * ks[:, None, :]
+            pid = pt_ref[b, rl_page(i)]
             phys = (rl_page(i) * p
                     + jax.lax.broadcasted_iota(jnp.int32, (1, 1, p), 2))
-            valid = (phys < tb) | ((phys >= tpb) & (phys < tpb + db))
+            valid = (((phys < tb) | ((phys >= tpb) & (phys < tpb + db)))
+                     & (pid != 0))
             s = jnp.where(valid, s, NEG_INF)
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
             w = jnp.where(valid, jnp.exp(s - m_new[..., None]), 0.0)
@@ -359,18 +425,143 @@ def _paged_kernel_q8(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
                 (w * vs[:, None, :]).astype(v.dtype), v,
                 (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32)    # [Hkv, G, D]
-            return acc * alpha[..., None] + pv_, m_new, l_new
+            if collect_mass:
+                macc = (macc * alpha[..., None]
+                        + jnp.sum(w, axis=-1)[..., None]
+                        * _mass_onehot(rl_page(i), mp_pad))
+            return acc * alpha[..., None] + pv_, m_new, l_new, macc
 
-        return jax.lax.fori_loop(0, n_used, body, (acc, m_i, l_i))
+        return jax.lax.fori_loop(0, n_used, body, carry0)
 
     acc0 = jnp.zeros((hkv, g, dd), jnp.float32)
     m0 = jnp.full((hkv, g), NEG_INF, jnp.float32)
     l0 = jnp.zeros((hkv, g), jnp.float32)
-    acc, m_f, l_f = run(acc0, m0, l0)
+    macc0 = jnp.zeros((hkv, g, max(mp_pad, 1)), jnp.float32)
+    acc, m_f, l_f, macc = run((acc0, m0, l0, macc0))
     norm = jnp.maximum(l_f, 1e-30)[..., None]
     o_ref[0] = acc / norm
     m_ref[0] = jnp.broadcast_to(m_f[..., None], (hkv, g, LSE_LANES))
     l_ref[0] = jnp.broadcast_to(l_f[..., None], (hkv, g, LSE_LANES))
+    if collect_mass:
+        mass_ref[0] = jnp.sum(macc / norm, axis=(0, 1)) / (hkv * g)
+
+
+def _paged_kernel_q4(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
+                     q_ref, pk_ref, pv_ref, pks_ref, pvs_ref,
+                     *refs, collect_mass=False):
+    """Packed-int4-pool variant (ISSUE 15): pages hold two nibbles per
+    byte ([L, n_pages, Hkv, P, D/2] uint8, channel d in the low nibble
+    and channel d+D/2 in the high — see :mod:`kubegpu_tpu.ops.kvquant`)
+    with ONE f32 scale per group of g tokens ([L, n_pages, Hkv, P/g]).
+    Unpacking is a lane-dim concatenation of the two nibble halves
+    (Mosaic-safe; no sublane reshape), and the group scale broadcasts
+    to per-token lanes with a lane-merging reshape — after which the
+    fold into score/probability matrices is exactly the q8 kernel's.
+    KV streams from HBM at a QUARTER of the bf16 bytes, which is the
+    whole point: the reclaimed budget comes back as slots."""
+    if collect_mass:
+        o_ref, m_ref, l_ref, mass_ref, kbuf, vbuf, ksbuf, vsbuf, \
+            sems = refs
+        mp_pad = mass_ref.shape[1]
+    else:
+        o_ref, m_ref, l_ref, kbuf, vbuf, ksbuf, vsbuf, sems = refs
+        mass_ref = None
+        mp_pad = 0
+    b = pl.program_id(0)
+    hkv, g, dd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    p = kbuf.shape[2]
+    n_groups = ksbuf.shape[2]           # P // kv_group
+    gsz = p // n_groups
+    layer = layer_ref[0]
+    tb, tpb, db = t_ref[b], tpad_ref[b], d_ref[b]
+    n_prompt = (tb + p - 1) // p
+    dstart = tpb // p
+    n_dec = (db + p - 1) // p
+    n_used = jnp.maximum(n_prompt + n_dec, 1)
+
+    def rl_page(i):
+        return jnp.where(i < n_prompt, i, dstart + (i - n_prompt))
+
+    def dma_quad(i, slot):
+        pid = pt_ref[b, rl_page(i)]
+        return (pltpu.make_async_copy(pk_ref.at[layer, pid],
+                                      kbuf.at[slot], sems.at[slot, 0]),
+                pltpu.make_async_copy(pv_ref.at[layer, pid],
+                                      vbuf.at[slot], sems.at[slot, 1]),
+                pltpu.make_async_copy(pks_ref.at[layer, pid],
+                                      ksbuf.at[slot], sems.at[slot, 2]),
+                pltpu.make_async_copy(pvs_ref.at[layer, pid],
+                                      vsbuf.at[slot], sems.at[slot, 3]))
+
+    def unpack(packed, dtype):
+        """uint8 [Hkv, P, D/2] → [Hkv, P, D]: nibble halves concat on
+        the lane dim (kvquant.q4_unpack's layout, in-kernel)."""
+        lo = (packed & 0xF).astype(jnp.int8) - 8
+        hi = (packed >> 4).astype(jnp.int8) - 8
+        return jnp.concatenate([lo, hi], axis=-1).astype(dtype)
+
+    def group_scales(sc):
+        """[Hkv, P/g] f32 → per-token [Hkv, P] (lane-merge reshape)."""
+        return jnp.broadcast_to(
+            sc[:, :, None], (hkv, n_groups, gsz)).reshape(hkv, p)
+
+    def run(carry0):
+        for d_ in dma_quad(0, 0):
+            d_.start()
+
+        def body(i, carry):
+            acc, m_prev, l_prev, macc = carry
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < n_used)
+            def _prefetch():
+                for d_ in dma_quad(i + 1, 1 - slot):
+                    d_.start()
+
+            for d_ in dma_quad(i, slot):
+                d_.wait()
+            qv = q_ref[0]
+            k = unpack(kbuf[slot], qv.dtype)           # [Hkv, P, D]
+            v = unpack(vbuf[slot], qv.dtype)
+            ks = group_scales(ksbuf[slot])             # [Hkv, P] f32
+            vs = group_scales(vsbuf[slot])
+            s = jax.lax.dot_general(
+                qv, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * (dd ** -0.5)
+            s = s * ks[:, None, :]
+            pid = pt_ref[b, rl_page(i)]
+            phys = (rl_page(i) * p
+                    + jax.lax.broadcasted_iota(jnp.int32, (1, 1, p), 2))
+            valid = (((phys < tb) | ((phys >= tpb) & (phys < tpb + db)))
+                     & (pid != 0))
+            s = jnp.where(valid, s, NEG_INF)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            w = jnp.where(valid, jnp.exp(s - m_new[..., None]), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(w, axis=-1)
+            pv_ = jax.lax.dot_general(
+                (w * vs[:, None, :]).astype(v.dtype), v,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)    # [Hkv, G, D]
+            if collect_mass:
+                macc = (macc * alpha[..., None]
+                        + jnp.sum(w, axis=-1)[..., None]
+                        * _mass_onehot(rl_page(i), mp_pad))
+            return acc * alpha[..., None] + pv_, m_new, l_new, macc
+
+        return jax.lax.fori_loop(0, n_used, body, carry0)
+
+    acc0 = jnp.zeros((hkv, g, dd), jnp.float32)
+    m0 = jnp.full((hkv, g), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hkv, g), jnp.float32)
+    macc0 = jnp.zeros((hkv, g, max(mp_pad, 1)), jnp.float32)
+    acc, m_f, l_f, macc = run((acc0, m0, l0, macc0))
+    norm = jnp.maximum(l_f, 1e-30)[..., None]
+    o_ref[0] = acc / norm
+    m_ref[0] = jnp.broadcast_to(m_f[..., None], (hkv, g, LSE_LANES))
+    l_ref[0] = jnp.broadcast_to(l_f[..., None], (hkv, g, LSE_LANES))
+    if collect_mass:
+        mass_ref[0] = jnp.sum(macc / norm, axis=(0, 1)) / (hkv * g)
 
 
 def _paged_kernel_bias(layer_ref, pt_ref, t_ref, tpad_ref, d_ref,
@@ -530,31 +721,44 @@ def paged_attention_biased(q: jax.Array, pool_k: jax.Array,
             l[..., 0].reshape(b, hq))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "collect_mass"))
 def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
                     page_table: jax.Array, layer: jax.Array,
                     t: jax.Array, t_pad: jax.Array, d: jax.Array,
                     k_scale: jax.Array | None = None,
                     v_scale: jax.Array | None = None,
-                    interpret: bool = False
-                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+                    interpret: bool = False,
+                    collect_mass: bool = False):
     """Paged decode attention over the pool (one layer), via the page
     table.  Same signature/partials as :func:`paged_attention_ref`;
     one grid program per row walks that row's used pages with manual
     double-buffered DMAs (see :func:`_paged_kernel`), so reads scale
     with what rows actually hold and nothing like a ``[B, S, D]``
     gather is ever materialized.  Empty rows (t = d = 0) run a single
-    fully-masked iteration and emit zeros."""
+    fully-masked iteration and emit zeros.
+
+    The kernel flavor is picked from the pool dtype: bf16 pages run
+    :func:`_paged_kernel`; int8 pages (``k_scale`` per-token) run
+    :func:`_paged_kernel_q8`; uint8 means PACKED int4 pages with
+    per-group scales and runs :func:`_paged_kernel_q4`.  With
+    ``collect_mass`` a fourth output carries the per-page normalized
+    attention mass [B, max_pages] — the accumulator the engine's
+    attention-aware eviction reads."""
     b, hq, dd = q.shape
-    n_layers, n_pages_total, hkv, p, _ = pool_k.shape
+    n_layers, n_pages_total, hkv, p, pdim = pool_k.shape
     max_pages = page_table.shape[1]
     g = hq // hkv
     if hq % hkv:
         raise ValueError(f"Hq {hq} not a multiple of Hkv {hkv}")
 
     kv_dtype = pool_k.dtype
+    q4 = kv_dtype == jnp.uint8
     quant = k_scale is not None
+    if q4 and not quant:
+        raise ValueError("packed int4 pool requires group scales")
     n_extra = 2 if quant else 0
+    mp_pad = -(-max_pages // LSE_LANES) * LSE_LANES
     out_specs = [
         pl.BlockSpec((1, hkv, g, dd), lambda bb, *_: (bb, 0, 0, 0)),
         pl.BlockSpec((1, hkv, g, LSE_LANES),
@@ -562,13 +766,23 @@ def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
         pl.BlockSpec((1, hkv, g, LSE_LANES),
                      lambda bb, *_: (bb, 0, 0, 0)),
     ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, hkv, g, dd), jnp.float32),
+        jax.ShapeDtypeStruct((b, hkv, g, LSE_LANES), jnp.float32),
+        jax.ShapeDtypeStruct((b, hkv, g, LSE_LANES), jnp.float32),
+    ]
+    if collect_mass:
+        out_specs.append(pl.BlockSpec((1, mp_pad),
+                                      lambda bb, *_: (bb, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b, mp_pad), jnp.float32))
     scratch = [
-        pltpu.VMEM((2, hkv, p, dd), kv_dtype),   # k double buffer
-        pltpu.VMEM((2, hkv, p, dd), kv_dtype),   # v double buffer
+        pltpu.VMEM((2, hkv, p, pdim), kv_dtype),   # k double buffer
+        pltpu.VMEM((2, hkv, p, pdim), kv_dtype),   # v double buffer
     ]
     if quant:
-        scratch += [pltpu.VMEM((2, hkv, p), jnp.float32),
-                    pltpu.VMEM((2, hkv, p), jnp.float32)]
+        n_sc = k_scale.shape[3]   # P (int8 per-token) or P/g (int4)
+        scratch += [pltpu.VMEM((2, hkv, n_sc), jnp.float32),
+                    pltpu.VMEM((2, hkv, n_sc), jnp.float32)]
     scratch.append(pltpu.SemaphoreType.DMA((2, 4 if quant else 2)))
     args = [jnp.atleast_1d(layer).astype(jnp.int32), page_table,
             t.astype(jnp.int32), t_pad.astype(jnp.int32),
@@ -576,8 +790,10 @@ def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
             pool_k, pool_v]
     if quant:
         args += [k_scale, v_scale]
-    out, m, l = pl.pallas_call(
-        _paged_kernel_q8 if quant else _paged_kernel,
+    kern = (_paged_kernel_q4 if q4
+            else _paged_kernel_q8 if quant else _paged_kernel)
+    outs = pl.pallas_call(
+        functools.partial(kern, collect_mass=collect_mass),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=5,
             grid=(b,),
@@ -588,12 +804,10 @@ def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
             out_specs=out_specs,
             scratch_shapes=scratch,
         ),
-        out_shape=[
-            jax.ShapeDtypeStruct((b, hkv, g, dd), jnp.float32),
-            jax.ShapeDtypeStruct((b, hkv, g, LSE_LANES), jnp.float32),
-            jax.ShapeDtypeStruct((b, hkv, g, LSE_LANES), jnp.float32),
-        ],
+        out_shape=out_shape,
         interpret=interpret,
     )(*args)
-    return (out.reshape(b, hq, dd), m[..., 0].reshape(b, hq),
-            l[..., 0].reshape(b, hq))
+    out, m, l = outs[0], outs[1], outs[2]
+    ret = (out.reshape(b, hq, dd), m[..., 0].reshape(b, hq),
+           l[..., 0].reshape(b, hq))
+    return ret + (outs[3][:, :max_pages],) if collect_mass else ret

@@ -224,7 +224,15 @@ class DeviceScheduler:
                 ("serve_autoscale_events",
                  "serving_autoscale_events"),
                 ("serve_routing_affinity_hits",
-                 "serving_routing_affinity_hits")):
+                 "serving_routing_affinity_hits"),
+                # kv compression & eviction (ISSUE 15): the scheduler
+                # sees each pod's kv format, eviction pressure, and
+                # the measured quality cost of running compressed
+                ("serve_kv_bits", "serving_kv_bits"),
+                ("serve_pages_evicted_total",
+                 "serving_pages_evicted_total"),
+                ("serve_kv_quality_delta",
+                 "serving_kv_quality_delta")):
             v = out.get(src)
             if v is not None:
                 self.metrics.set_gauge(dst, v)

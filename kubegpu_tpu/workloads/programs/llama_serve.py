@@ -40,6 +40,17 @@ Env knobs:
                  fused ask on the dense fallback aborts.  The pod
                  echoes serve_engine_cfg_fused_k and
                  serve_fused_dispatches
+  SERVE_KV_BITS  continuous+paged: KV-pool element width — 16 (bf16),
+                 8 (per-token int8, alias of SERVE_KV_INT8=1) or 4
+                 (grouped packed int4, ISSUE 15).  The pod echoes
+                 serve_kv_bits
+  SERVE_EVICT_POLICY  continuous+paged: attention-aware page eviction
+                 — "window" (drop prompt pages wholly outside the
+                 trailing token window) or "mass" (drop low-attention-
+                 mass prompt pages); SERVE_EVICT_PARAM tunes the
+                 window length / mass threshold.  Plain-K=1-path only
+                 (no spec/fused/mesh); the pod echoes
+                 serve_pages_evicted_total and serve_kv_quality_delta
 
 The decode throughput metric subtracts a separately-timed prefill of
 the same configuration (the advisor's r2 finding: dividing by an
@@ -209,6 +220,26 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
     kv_int8 = paged and n_slots * prompt_t >= 16384
     if os.environ.get("SERVE_KV_INT8") is not None:
         kv_int8 = paged and os.environ["SERVE_KV_INT8"] == "1"
+    # kv bit-width (ISSUE 15): SERVE_KV_BITS=4 serves the grouped
+    # packed-int4 pool (two channels per byte + per-group f32 scales);
+    # =8 is an alias of SERVE_KV_INT8=1.  Paged-only — under strict
+    # mode an int4 ask on the dense fallback aborts.
+    kv_bits = None
+    kb_env = os.environ.get("SERVE_KV_BITS")
+    if kb_env:
+        kv_bits = int(kb_env)
+        if kv_bits == 4 and not paged:
+            from kubegpu_tpu.ops.strict import fallback
+            fallback("llama_serve.kv_bits",
+                     "SERVE_KV_BITS=4 needs the paged engine; the "
+                     "dense fallback has no packed page pool")
+            kv_bits = None
+        elif kv_bits == 8:
+            kv_int8, kv_bits = paged, None
+        elif kv_bits == 16:
+            kv_int8, kv_bits = False, None
+        if kv_bits == 4:
+            kv_int8 = False
     # serving fast-path knobs (prefix caching + chunked prefill ride
     # the paged pool; defaults off so the harvested figure stays
     # comparable round-over-round unless explicitly enabled)
@@ -243,6 +274,21 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                  f"SERVE_FUSED_K={fused_k} needs the paged engine; "
                  "the dense fallback syncs every tick")
         fused_k = 1
+    # attention-aware page eviction (ISSUE 15): rides the plain K=1
+    # decode path only — the mass signal comes out of the unfused
+    # decode block, and a mesh-sharded pool's mass is a per-shard
+    # statistic.  An incompatible ask degrades loudly, not silently.
+    evict_policy = os.environ.get("SERVE_EVICT_POLICY") or None
+    ep_env = os.environ.get("SERVE_EVICT_PARAM")
+    evict_param = float(ep_env) if ep_env else None
+    if evict_policy and (not paged or spec_gamma or fused_k > 1
+                         or int(os.environ.get("SERVE_TP", "1")) > 1):
+        from kubegpu_tpu.ops.strict import fallback
+        fallback("llama_serve.evict",
+                 f"SERVE_EVICT_POLICY={evict_policy} needs the paged "
+                 "plain-decode engine (no spec/fused/tp); eviction "
+                 "would silently stay off")
+        evict_policy = evict_param = None
     # mesh-native serving (SERVE_TP / SERVE_DP): shard the paged engine
     # over tp chips (per-chip pools hold Hkv/tp heads) and/or run dp
     # independent replicas behind one admission queue.  Degrades to
@@ -277,6 +323,8 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
     eng_kw = dict(n_slots=n_slots, max_len=max_len, stride=stride,
                   prompt_buckets=(prompt_t,), paged=paged,
                   page_size=page_size, kv_int8=kv_int8,
+                  kv_bits=kv_bits,
+                  evict_policy=evict_policy, evict_param=evict_param,
                   prefix_cache=prefix_cache, chunked_prefill=chunked,
                   spec_gamma=spec_gamma, draft_layers=draft_layers,
                   fused_ticks=fused_k,
@@ -417,7 +465,20 @@ def _serve_continuous(env, cfg, params, n_slots, prompt_t, steps,
                 ("serve_autoscale_events",
                  getattr(eng, "autoscale_events", 0)),
                 ("serve_replicas_active",
-                 len(eng._alive()) if hasattr(eng, "_alive") else 1)):
+                 len(eng._alive()) if hasattr(eng, "_alive") else 1),
+                # kv compression & eviction echo (ISSUE 15): the pod's
+                # kv format, how many resident pages the eviction
+                # policy dropped, and the measured quality delta (0.0
+                # until a harness calls note_kv_quality) — mirrored by
+                # the scheduler as serving_kv_bits etc.
+                ("serve_kv_bits",
+                 eng.kv_bits if hasattr(eng, "kv_bits")
+                 else eng.replicas[0].kv_bits),
+                ("serve_pages_evicted_total",
+                 eng.pages_evicted if hasattr(eng, "pages_evicted")
+                 else sum(e.pages_evicted for e in eng.replicas)),
+                ("serve_kv_quality_delta",
+                 getattr(eng, "kv_quality_delta", 0.0))):
             print(json.dumps({"metric": name, "value": value}))
         if tracer is not None:
             # trace echo: span count is harvestable; the full Perfetto

@@ -340,10 +340,10 @@ def test_repo_clean_jaxpr_audit():
     bad = [f for f in findings if not f.blessed]
     assert not bad, "\n".join(
         f"{f.path}:{f.line} {f.code} {f.message}" for f in bad)
-    # every serving executable was actually traced, on both engines
+    # every serving executable was actually traced, on all engines
     assert summary["total_eqns"] > 1000
     labels = {k.split(":", 1)[0] for k in summary["executables"]}
-    assert labels == {"bf16", "int8"}
+    assert labels == {"bf16", "int8", "int4"}
     assert all(s["eqns"] > 0 for s in summary["executables"].values())
 
 
@@ -375,8 +375,10 @@ def test_rule_table_is_mirrored_in_docs():
 def test_expected_signature_sets_are_wellformed():
     from kubegpu_tpu.analysis.jaxpr_audit import expected_signatures
     exp = expected_signatures()
-    assert set(exp) == {"plain", "spec"}
+    assert set(exp) == {"plain", "spec", "q4"}
     assert len(exp["plain"]) == 8 and len(exp["spec"]) == 6
+    # the int4 engine must not introduce any new top-level shapes
+    assert exp["q4"] == exp["plain"]
     for sig in exp["plain"] | exp["spec"]:
         name = sig.split("(", 1)[0]
         assert name in {"decode_block", "decode_fused", "prefill_wave",
@@ -390,8 +392,8 @@ def test_compile_census_matches_expected_set():
     from kubegpu_tpu.analysis.jaxpr_audit import compile_census
     findings, summary = compile_census()
     assert findings == [], "\n".join(f.message for f in findings)
-    assert summary["signatures_total"] == 14
-    for label in ("plain", "spec"):
+    assert summary["signatures_total"] == 22
+    for label in ("plain", "spec", "q4"):
         eng = summary["engines"][label]
         assert eng["observed"] == eng["expected"]
         assert eng["total_first_compile_ms"] > 0

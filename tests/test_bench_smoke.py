@@ -166,7 +166,7 @@ def test_serving_bench_smoke_parses_and_carries_keys():
     assert hb["donation_on"]["samples"] > 0
     assert hb["donation_on"]["peak_bytes"] > 0
     assert hb["aliases_covered"] is True
-    for label in ("bf16", "int8"):
+    for label in ("bf16", "int8", "int4"):
         rep = hb["input_output_aliases"][label]
         assert rep, label                        # census is non-empty
         for name, row in rep.items():
@@ -177,6 +177,10 @@ def test_serving_bench_smoke_parses_and_carries_keys():
     # AND QTensor scales (a half-donated quantized pool would read
     # "2/4" here)
     assert hb["input_output_aliases"]["int8"]["decode_block"]["args"][
+        "pool"] == "4/4"
+    # the int4 pool's rows must alias all four leaves too — packed
+    # nibble values AND the grouped f32 scales
+    assert hb["input_output_aliases"]["int4"]["decode_block"]["args"][
         "pool"] == "4/4"
     ch_ = hb["capacity_headroom"]
     assert ch_["fits_budget"] is True
@@ -191,7 +195,7 @@ def test_serving_bench_smoke_parses_and_carries_keys():
     # recompilation gate reads.
     cc = doc["cb_compile_census"]
     assert cc["violations"] == 0, cc["violation_messages"]
-    assert cc["signatures_total"] == 14
+    assert cc["signatures_total"] == 22
     for name in ("decode_block", "decode_fused", "prefill_wave",
                  "prefill_chunk", "adopt_wave", "activate_slot",
                  "verify_block", "verify_fused", "export_chain",
@@ -199,9 +203,33 @@ def test_serving_bench_smoke_parses_and_carries_keys():
         row = cc["per_executable"][name]
         assert row["signatures"] >= 1, name
         assert row["first_compile_ms"] > 0, name
-    for label in ("plain", "spec"):
+    for label in ("plain", "spec", "q4"):
         assert cc["engines"][label]["observed"] == \
             cc["engines"][label]["expected"]
+
+    # grouped int4 KV + attention-aware eviction (ISSUE 15): the int4
+    # engine must fit >= 1.5x the concurrent slots inside the byte
+    # budget the donation-off int8 engine needed, complete every
+    # request, and carry a MEASURED (bounded) quality delta; both
+    # eviction policies must actually drop pages and report their own
+    # measured deltas.
+    kv = doc["cb_kv_capacity"]
+    assert kv["protocol"] == "equal_budget_capacity_ab"
+    assert kv["slots_ratio"] >= 1.5
+    assert kv["fits_budget"] is True
+    assert kv["capacity_ok"] is True
+    assert kv["int4_engine"]["peak_bytes"] <= kv["byte_budget"]
+    assert kv["int4_engine"]["completed"] == \
+        kv["int4_engine"]["requests"]
+    assert kv["int4_engine"]["tokens"] > 0
+    assert kv["quality_ok"] is True
+    assert 0.0 <= kv["quality_delta_int4"] <= kv["quality_bound"]
+    for policy in ("window", "mass"):
+        row = kv["eviction"][policy]
+        assert row["pages_evicted"] >= 1, policy
+        assert row["tokens"] > 0, policy
+        assert 0.0 <= row["quality_delta"] <= kv["quality_bound"], \
+            policy
 
     # disaggregated prefill/decode serving (ISSUE 11): the equal-chip
     # A/B must complete the window BIT-EXACT on the role-split pool

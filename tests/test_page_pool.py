@@ -143,17 +143,28 @@ class TestLeakDetector:
             eng.check_page_invariants()
 
 
+# the three kv-pool formats every fuzz/property suite must cover
+# (ISSUE 15): bf16, per-token int8, grouped packed int4
+KV_MODES = [{}, {"kv_int8": True}, {"kv_bits": 4}]
+KV_IDS = ["bf16", "int8", "int4"]
+
+
 class TestPagePoolFuzz:
-    def test_randomized_churn_no_double_use_no_leak(self, tiny):
-        """120 random submit/step events with mixed prompt lengths and
+    @pytest.mark.parametrize("kv", KV_MODES, ids=KV_IDS)
+    def test_randomized_churn_no_double_use_no_leak(self, tiny, kv):
+        """Random submit/step events with mixed prompt lengths and
         generation budgets; invariants checked after every tick; every
-        request must finish with exactly its requested token count."""
+        request must finish with exactly its requested token count —
+        identically for all three kv-pool formats.  The allocator path
+        under test is format-oblivious, so the quantized reruns use a
+        shorter event stream (they exist to prove the packed pools
+        don't perturb accounting, not to re-fuzz the allocator)."""
         cfg, params = tiny
         rng = np.random.default_rng(42)
-        eng = make_engine(cfg, params)
+        eng = make_engine(cfg, params, **kv)
         want: dict[int, int] = {}
         done: dict[int, int] = {}
-        for _ in range(120):
+        for _ in range(120 if not kv else 60):
             if rng.random() < 0.5 and len(eng.queue) < 4:
                 plen = int(rng.integers(1, 16))
                 new = int(rng.integers(1, 7))
@@ -536,17 +547,21 @@ class TestRefcountedPrefixPool:
         assert len(eng._free_pages) + len(eng._page_refs) == \
             eng.total_pages
 
-    def test_churn_with_prefix_cache_no_leak(self, tiny):
+    @pytest.mark.parametrize("kv", KV_MODES, ids=KV_IDS)
+    def test_churn_with_prefix_cache_no_leak(self, tiny, kv):
         """The original fuzz churn, refcount edition: random mixed
         traffic (some sharing prefixes) through a cache-enabled
         engine; partition invariants hold every tick and every request
-        finishes exactly."""
+        finishes exactly — for all three kv-pool formats (aliased int4
+        pages share packed bytes AND group scales).  Quantized reruns
+        use a shorter stream — the refcount law is format-oblivious,
+        the rerun proves the packed pools don't perturb it."""
         cfg, params = tiny
         rng = np.random.default_rng(7)
-        eng = self._mk(cfg, params)
+        eng = self._mk(cfg, params, **kv)
         shared = [(i * 5 + 3) % cfg.vocab_size for i in range(8)]
         want, done = {}, {}
-        for _ in range(80):
+        for _ in range(80 if not kv else 40):
             if rng.random() < 0.5 and len(eng.queue) < 4:
                 new = int(rng.integers(1, 6))
                 if rng.random() < 0.5:
@@ -618,6 +633,21 @@ class TestDonatedHandleHygiene:
             assert h.is_deleted(), f"{name} survived donation"
         assert len(eng.drain()) == 1
 
+    def test_int4_leaves_die_with_their_values(self, tiny):
+        # the packed int4 pool donates ALL FOUR leaves — two uint8
+        # nibble planes and two f32 group-scale planes; any survivor
+        # would double the very HBM the format exists to reclaim
+        cfg, params = tiny
+        eng = make_engine(cfg, params, kv_bits=4)
+        eng.submit(list(range(1, 9)), 6)
+        eng.step()
+        stale = {n: eng.pool[n] for n in
+                 ("k", "v", "k_scale", "v_scale")}
+        eng.step()
+        for name, h in stale.items():
+            assert h.is_deleted(), f"int4 {name} survived donation"
+        assert len(eng.drain()) == 1
+
     def test_donation_off_keeps_old_handles_readable(self, tiny):
         cfg, params = tiny
         eng = make_engine(cfg, params, donate=False)
@@ -643,26 +673,24 @@ class TestChainMigration:
         kw.setdefault("prefill_chunk", 8)
         return make_engine(cfg, params, **kw)
 
-    @pytest.mark.parametrize("kv_int8", [False, True],
-                             ids=["bf16", "int8"])
-    def test_export_mutate_import_bit_exact_refcounts(
-            self, tiny, kv_int8):
+    @pytest.mark.parametrize("kv", KV_MODES, ids=KV_IDS)
+    def test_export_mutate_import_bit_exact_refcounts(self, tiny, kv):
         """export chain → churn the SOURCE pool (its freed pages get
         reused by new traffic) → import into a fresh engine: the
         destination pages equal the export byte-for-byte (int8 scales
-        included), refcounts hold on both pools, and the adopted
-        request decodes to the same greedy tokens as a never-migrated
-        run.  Donation is ON (the make_engine default) on every engine
-        involved."""
+        and int4 packed bytes + GROUP scales included), refcounts hold
+        on both pools, and the adopted request decodes to the same
+        greedy tokens as a never-migrated run.  Donation is ON (the
+        make_engine default) on every engine involved."""
         cfg, params = tiny
-        src = self._mk(cfg, params, kv_int8=kv_int8)
-        dst = self._mk(cfg, params, kv_int8=kv_int8)
+        src = self._mk(cfg, params, **kv)
+        dst = self._mk(cfg, params, **kv)
         assert src._donate and dst._donate
         prompt = [(i * 7 + 2) % cfg.vocab_size for i in range(12)]
         total = 6
 
         # never-migrated reference: same prompt, full budget
-        ref_eng = self._mk(cfg, params, kv_int8=kv_int8)
+        ref_eng = self._mk(cfg, params, **kv)
         ref_eng.submit(prompt, total)
         ref = ref_eng.drain()[0].tokens
 
@@ -675,7 +703,7 @@ class TestChainMigration:
         assert src.take_export(rid) is None            # exactly-once
         frozen = {n: np.asarray(a).copy()
                   for n, a in exp["chain"].items()}
-        if kv_int8:
+        if kv:                       # int8 AND int4 carry scale leaves
             assert "k_scale" in frozen and "v_scale" in frozen
 
         # churn the source: freed pages are reallocated and rewritten
@@ -794,3 +822,159 @@ class TestChainMigration:
         # the prefill role died: late arrivals served degraded on the
         # decode replica, but anything exported pre-kill migrated
         assert pool.migrations <= len(stream)
+
+
+class TestAttentionAwareEviction:
+    """Attention-aware page eviction (ISSUE 15): cold PROMPT pages
+    release mid-decode through the standing refcount machinery and
+    become page-id-0 holes the kernels' validity masks skip.  The
+    module-default shapes (buckets 8/16, P=8) never clear the safety
+    rails (sink page + two survivors), so this class runs 27-token
+    prompts padded to bucket 32 — four prompt pages, two of them
+    evictable.  Evicting engines are checked with the ENGINE's
+    hole-aware ``check_page_invariants`` (armed every tick via
+    debug_invariants); the file-local partition helpers above assert
+    zero-free rows and do NOT apply once holes exist."""
+
+    def _mk(self, cfg, params, **kw):
+        kw.setdefault("debug_invariants", True)
+        # the 40 bucket exists for quarantine REPLAYS: replay prompt =
+        # original prompt + accepted tokens can exceed 32
+        return ContinuousBatcher(
+            params, cfg, n_slots=3, max_len=48, stride=2,
+            prompt_buckets=(32, 40), paged=True, page_size=8, **kw)
+
+    def _prompt(self, eng, j=0, plen=27):
+        return [(5 * j + 3 * i + 2) % eng.cfg.vocab_size
+                for i in range(plen)]
+
+    def _run_checked(self, eng, n_reqs, n_new=8, max_ticks=300):
+        """Drive to drain, and after every tick re-derive the eviction
+        rails from before/after page-table snapshots: a position that
+        became a hole must have held a single-owner, non-prefix-
+        registered page, and must never be the slot's first (attention
+        sink) page; at least two live prompt pages must remain."""
+        rids = [eng.submit(self._prompt(eng, j), n_new)
+                for j in range(n_reqs)]
+        done, ticks = [], 0
+        while (eng.queue or eng.slot_req) and ticks < max_ticks:
+            owner = {s: r.rid for s, r in eng.slot_req.items()}
+            rows = {s: eng._pt[s].copy() for s in owner}
+            refs = dict(eng._page_refs)
+            keyed = set(getattr(eng, "_page_key", ()))
+            done.extend(eng.step())
+            eng.check_page_invariants()
+            for s, rid in owner.items():
+                r = eng.slot_req.get(s)
+                if r is None or r.rid != rid:
+                    continue         # slot retired/recycled, not a hole
+                before, after = rows[s], eng._pt[s]
+                for pi in np.nonzero((before != 0) & (after == 0))[0]:
+                    page = int(before[pi])
+                    assert pi >= 1, "evicted the attention sink page"
+                    assert refs.get(page, 0) == 1, \
+                        f"evicted shared page {page} (ref " \
+                        f"{refs.get(page)})"
+                    assert page not in keyed, \
+                        f"evicted prefix-registered page {page}"
+                    assert (after[:int(eng._tpad[s]) // eng.page_size]
+                            != 0).sum() >= 2, "fewer than 2 live " \
+                        "prompt pages survived"
+            ticks += 1
+        assert not eng.queue and not eng.slot_req, "did not drain"
+        return rids, done
+
+    @pytest.mark.parametrize("policy,param",
+                             [("window", 8.0), ("mass", 0.25)],
+                             ids=["window", "mass"])
+    def test_evicts_cold_pages_and_completes_exactly(
+            self, tiny, policy, param):
+        """Both policies must actually drop pages on long prompts, hand
+        the HBM back to the allocator mid-decode (free-list grows while
+        the slot still decodes), and still finish every request with
+        exactly its requested token count."""
+        cfg, params = tiny
+        eng = self._mk(cfg, params, evict_policy=policy,
+                       evict_param=param)
+        rids, done = self._run_checked(eng, n_reqs=3)
+        assert eng.pages_evicted >= 1, f"{policy} never evicted"
+        by_rid = {r.rid: r for r in done}
+        assert sorted(by_rid) == sorted(rids)
+        for r in done:
+            assert r.error is None and len(r.tokens) == 8
+        # drained: every page is back on the free list, holes included
+        assert len(eng._free_pages) == eng.total_pages
+        eng.check_page_invariants()
+
+    def test_evict_never_drops_refcounted_prefix_page(self, tiny):
+        """Shared-prefix traffic under aggressive window eviction: the
+        per-tick rail audit in _run_checked proves no multi-owner or
+        prefix-registered page is ever punched out, while the cache
+        still aliases (prefix hits happen) and every request
+        completes."""
+        cfg, params = tiny
+        eng = self._mk(cfg, params, prefix_cache=True,
+                       prefill_chunk=8, evict_policy="window",
+                       evict_param=8.0)
+        shared = [(i * 5 + 3) % cfg.vocab_size for i in range(16)]
+        rids = [eng.submit(shared + [(31 + 7 * j + i) % cfg.vocab_size
+                                     for i in range(11)], 8)
+                for j in range(3)]
+        done, ticks, saw_multi = [], 0, False
+        while (eng.queue or eng.slot_req) and ticks < 300:
+            owner = {s: r.rid for s, r in eng.slot_req.items()}
+            rows = {s: eng._pt[s].copy() for s in owner}
+            refs = dict(eng._page_refs)
+            keyed = set(eng._page_key)
+            done.extend(eng.step())
+            eng.check_page_invariants()
+            saw_multi = saw_multi or any(
+                v > 1 for v in eng._page_refs.values())
+            for s, rid in owner.items():
+                r = eng.slot_req.get(s)
+                if r is None or r.rid != rid:
+                    continue
+                before, after = rows[s], eng._pt[s]
+                for pi in np.nonzero((before != 0) & (after == 0))[0]:
+                    page = int(before[pi])
+                    assert refs.get(page, 0) == 1 and \
+                        page not in keyed, \
+                        f"eviction punched shared/registered page " \
+                        f"{page}"
+            ticks += 1
+        assert saw_multi, "prefix cache never aliased a page"
+        assert eng.prefix_hits >= 1
+        assert sorted(r.rid for r in done) == sorted(rids)
+        assert all(r.error is None and len(r.tokens) == 8
+                   for r in done)
+
+    def test_eviction_off_int4_deterministic_replay_exactly_once(
+            self, tiny):
+        """ISSUE 15 acceptance: with eviction off, the packed-int4
+        engine is fully deterministic — two engines fed the identical
+        schedule, INCLUDING a mid-decode NaN poison + quarantine
+        replay, emit identical greedy tokens, and the poisoned request
+        completes exactly once (the replay requantizes the same prompt
+        bytes, so int4 rounding cannot drift across the retry)."""
+        cfg, params = tiny
+
+        def run():
+            eng = self._mk(cfg, params, kv_bits=4)
+            rids = [eng.submit(self._prompt(eng, j), 8)
+                    for j in range(3)]
+            seen, ticks, poisoned = {}, 0, False
+            while (eng.queue or eng.slot_req) and ticks < 300:
+                if not poisoned:     # lands at earliest eligibility
+                    poisoned = eng._poison_one_slot()
+                for r in eng.step():
+                    assert r.rid not in seen, "completed twice"
+                    seen[r.rid] = list(r.tokens)
+                eng.check_page_invariants()
+                ticks += 1
+            assert poisoned and eng.slots_quarantined >= 1
+            assert eng.requests_retried >= 1
+            return [seen.get(r) for r in rids]
+
+        a, b = run(), run()
+        assert all(t is not None and len(t) == 8 for t in a)
+        assert a == b, "eviction-off int4 replay drifted"

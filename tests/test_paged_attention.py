@@ -24,10 +24,14 @@ def state():
     pool_v = jnp.asarray(rng.normal(size=(L, N_PAGES, HKV, P, D)),
                          jnp.float32)
     q = jnp.asarray(rng.normal(size=(B, HQ, D)), jnp.float32)
-    # row0: prompt 5 (page 0), decode region at 8 with 3 written;
-    # row1: prompt 13 (pages 0-1), nothing decoded;
-    # row2: empty (never admitted — zeroed page-table row)
-    pt = jnp.asarray([[0, 1, 2, 0], [3, 4, 5, 6], [0, 0, 0, 0]],
+    # row0: prompt 5 (physical page 7), decode region at 8 with 3
+    #       written (physical page 1);
+    # row1: prompt 13 (physical pages 3-4), nothing decoded;
+    # row2: empty (never admitted — zeroed page-table row).
+    # Physical page id 0 is the engine's trash page and, since the
+    # eviction work, an in-chain HOLE the validity masks skip — so no
+    # live chain entry may use it.
+    pt = jnp.asarray([[7, 1, 2, 0], [3, 4, 5, 6], [0, 0, 0, 0]],
                      jnp.int32)
     t = jnp.asarray([5, 13, 0], jnp.int32)
     tpad = jnp.asarray([8, 16, 0], jnp.int32)
@@ -56,8 +60,9 @@ class TestBf16Pool:
             interpret=True)
         kl = np.asarray(pool_k)[1]
         vl = np.asarray(pool_v)[1]
-        k_full = np.concatenate([kl[0], kl[1]], axis=1)   # phys 0..15
-        v_full = np.concatenate([vl[0], vl[1]], axis=1)
+        # row0's chain: physical page 7 (prompt) then 1 (decode)
+        k_full = np.concatenate([kl[7], kl[1]], axis=1)
+        v_full = np.concatenate([vl[7], vl[1]], axis=1)
         valid = np.array([p_ < 5 or 8 <= p_ < 11 for p_ in range(16)])
         qg = np.asarray(q)[0].reshape(HKV, HQ // HKV, D)
         s = np.einsum("kgd,ksd->kgs", qg, k_full) / np.sqrt(D)
