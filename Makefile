@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
         fused-smoke hbm-smoke kv-smoke disagg-smoke slo-smoke \
-        route-smoke analyze clean
+        route-smoke fleet-smoke analyze clean
 
 all: native
 
@@ -162,6 +162,27 @@ route-smoke: analyze            # ISSUE 14 closing the loop: routing
 		assert a['scale_ups'] >= 1 and a['scale_downs'] >= 1, a; \
 		assert a['drain_replays'] >= 1, a; \
 		assert a['exactly_once'] and a['bit_exact'], a"
+
+fleet-smoke:                    # ISSUE 19 fleet-scale robustness: the
+	# discrete-event harness unit suite (sim-engine determinism,
+	# correlated domain kill, watch-channel weather, rolling upgrade
+	# waves, journal crash recovery), then the full chaos matrix over
+	# 64 simulated replicas — zero lost/duplicated, no tier
+	# inversion, every leg's outcomes identical to the twin.
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q
+	JAX_PLATFORMS=cpu $(PY) -c "import json; \
+		from kubegpu_tpu.benchmark import run_serving_bench_smoke; \
+		row = run_serving_bench_smoke(legs=['cb_fleet_chaos']); \
+		print(json.dumps(row, indent=1)); \
+		f = row['cb_fleet_chaos']; \
+		assert f['fleet_replicas'] >= 64, f; \
+		assert f['domain_kill']['kill_fraction'] >= 0.25, f; \
+		assert f['exactly_once'], 'lost or duplicated requests'; \
+		assert f['tier_inversions'] == 0, f; \
+		assert f['outcomes_identical'], 'outcomes diverged'; \
+		assert f['upgrade_waves'] >= 1, f; \
+		assert f['recovered_exactly_once'], f; \
+		assert f['deterministic'], f"
 
 trace-smoke:                    # ISSUE 6 observability: a traced serve
 	# window must yield ONE connected span tree from extender bind

@@ -1667,6 +1667,11 @@ def _families_bench(cfg, params, on_tpu) -> dict:
         # already pays for the full fused K sweep and the int4
         # capacity A/B in run_serving_bench_smoke
 
+    # fleet-scale robustness matrix (ISSUE 19) — entirely host-side
+    # discrete-event simulation, so the same full-size run rides both
+    # branches in well under a second
+    out["cb_fleet_chaos"] = _cb_fleet_chaos_bench()
+
     # --- train the bench model on a cyclic pattern --------------------
     # One training pays for TWO honest speculative rows: the PLD
     # (prompt-lookup) row below, and the self-draft row — which for
@@ -2621,6 +2626,153 @@ def _cb_autoscale_bench(params, cfg) -> dict:
     }
 
 
+def _cb_fleet_chaos_bench(replicas: int = 64, domains: int = 4,
+                          requests: int = 192) -> dict:
+    """Fleet-scale robustness matrix (ISSUE 19 tentpole): ONE seeded
+    diurnal/flash-crowd trace drives the REAL pool code over
+    ``replicas`` bench-calibrated simulated engines, four times —
+
+    - **twin**: uninterrupted reference run;
+    - **domain_kill**: a whole failure domain (≥ 25% of the fleet)
+      dies in ONE tick while the health-watch channel duplicates and
+      delays its eviction deliveries;
+    - **upgrade**: a rolling drain-wave across EVERY domain under a
+      surge budget that must hold the capacity floor;
+    - **crash_recovery**: the control plane is killed mid-trace and
+      rebuilt from its append-only journal, re-driving every in-flight
+      request exactly-once.
+
+    Gates (asserted by tier-1 via this row): zero lost, zero
+    duplicated, tier ordering never inverted, per-request outcomes of
+    every scenario leg IDENTICAL to the twin, and the whole matrix
+    deterministic by seed."""
+    import time
+
+    from kubegpu_tpu.fleet import (
+        ControlPlaneJournal,
+        FleetConfig,
+        ReplicaCosts,
+        compare_outcomes,
+        run_fleet,
+    )
+    from kubegpu_tpu.loadgen import LoadSpec, TierSpec, synth_trace
+    from kubegpu_tpu.obs.chaos import (
+        DOMAIN_KILL,
+        WATCH_DELAY,
+        WATCH_DUP,
+        DomainChaosEvent,
+        DomainChaosInjector,
+    )
+    from kubegpu_tpu.obs.metrics import MetricsRegistry
+
+    TIERS = (TierSpec("gold", ttft_slo_ticks=40,
+                      token_slo_ticks=40.0, share=0.2),
+             TierSpec("silver", ttft_slo_ticks=80,
+                      token_slo_ticks=80.0, share=0.3),
+             TierSpec("bronze", ttft_slo_ticks=10**6,
+                      token_slo_ticks=1e6, share=0.5))
+    trace = synth_trace(LoadSpec(
+        seed=1907, n_requests=requests, mean_iat_ticks=0.25,
+        tiers=TIERS, diurnal=True, flash_at=(10.0,),
+        flash_rate_x=4.0, flash_len_ticks=8.0))
+    costs = ReplicaCosts.from_bench()
+    cfg = FleetConfig(costs=costs)
+    reg = MetricsRegistry()
+
+    def _leg(**kw):
+        return run_fleet(trace, TIERS, cfg=cfg, replicas=replicas,
+                         domains=domains, metrics=reg, **kw)
+
+    def _weather():
+        # watch-channel weather around the kill: each eviction
+        # delivery arrives 3× and 4 ticks late — recovery must
+        # tolerate both without double-failover
+        return DomainChaosInjector(events=[
+            DomainChaosEvent(tick=18, kind=WATCH_DUP, dup=3,
+                             duration_ticks=6),
+            DomainChaosEvent(tick=18, kind=WATCH_DELAY,
+                             delay_ticks=4, duration_ticks=6),
+            DomainChaosEvent(tick=20, kind=DOMAIN_KILL,
+                             domain="rack1"),
+        ])
+
+    t0 = time.perf_counter()
+    twin = _leg()
+    kill = _leg(chaos=_weather())
+    kill2 = _leg(chaos=_weather())       # seed-determinism re-run
+    # floor HALF a domain above the post-kill worst case: the first
+    # drain batch lands exactly on the floor, so the wave only
+    # completes if the controller backfills mid-wave
+    floor = replicas - (replicas // domains) // 2
+    upg = _leg(upgrade=True, upgrade_floor=floor, upgrade_surge=4,
+               upgrade_start=8)
+    crash = _leg(journal=ControlPlaneJournal(), crash_at=25)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+
+    legs = {"domain_kill": kill, "upgrade": upg,
+            "crash_recovery": crash}
+    cmp_ = {name: compare_outcomes(twin.load, r.load)
+            for name, r in legs.items()}
+    exactly_once = all(r.load.lost == 0 and r.load.duplicated == 0
+                       for r in [twin, *legs.values()])
+    identical = all(c["identical"] for c in cmp_.values())
+    recovered = (crash.recoveries == 1 and crash.load.lost == 0
+                 and crash.load.duplicated == 0
+                 and cmp_["crash_recovery"]["identical"])
+
+    def _row(r, c=None):
+        out = {"completed": r.load.completed, "lost": r.load.lost,
+               "duplicated": r.load.duplicated, "ticks": r.load.ticks,
+               "tier_inversions": r.tier_inversions,
+               "failovers": r.failovers, "min_alive": r.min_alive,
+               "sim_ms": round(r.sim_ms, 1)}
+        if c is not None:
+            out["outcomes_identical"] = c["identical"]
+        return out
+
+    return {
+        "protocol": "fleet_discrete_event",
+        "fleet_replicas": replicas,
+        "domains": domains,
+        "requests": len(trace),
+        "costs_ms": {"block": round(costs.block_ms, 4),
+                     "prefill_per_token":
+                         round(costs.prefill_ms_per_token, 5),
+                     "migration": round(costs.migration_ms, 4)},
+        "twin": _row(twin),
+        "domain_kill": {
+            **_row(kill, cmp_["domain_kill"]),
+            "killed_replicas": kill.killed_replicas,
+            "kill_fraction": round(
+                kill.killed_replicas / replicas, 3),
+            "watch_delivered": kill.watch_delivered,
+        },
+        "upgrade": {
+            **_row(upg, cmp_["upgrade"]),
+            "waves": upg.upgrade_waves,
+            "upgraded_replicas": upg.upgraded_replicas,
+            "floor": floor,
+        },
+        "crash_recovery": {
+            **_row(crash, cmp_["crash_recovery"]),
+            "recoveries": crash.recoveries,
+            "redriven": crash.redriven,
+            "journal_records": crash.journal_records,
+        },
+        # headline gates (the tier-1 smoke asserts these)
+        "domains_killed": kill.domain_kills,
+        "exactly_once": exactly_once,
+        "outcomes_identical": identical,
+        "tier_inversions": sum(r.tier_inversions
+                               for r in [twin, *legs.values()]),
+        "upgrade_waves": upg.upgrade_waves,
+        "recovered_exactly_once": recovered,
+        "deterministic": compare_outcomes(
+            kill.load, kill2.load)["identical"],
+        "wall_ms_raw_weather": round(wall_ms, 1),
+    }
+
+
 def run_serving_bench_smoke(legs=None) -> dict:
     """Tiny-config run of ONLY the serving fast-path bench legs
     (prefix cache, chunked-prefill stall, equal-HBM mixed-length A/B,
@@ -2696,6 +2848,7 @@ def run_serving_bench_smoke(legs=None) -> dict:
         "cb_prefix_affinity": lambda: _cb_prefix_affinity_bench(
             params, cfg),
         "cb_autoscale": lambda: _cb_autoscale_bench(params, cfg),
+        "cb_fleet_chaos": _cb_fleet_chaos_bench,
         "cb_compile_census": _cb_compile_census_bench,
     }
     if legs is not None:
@@ -3362,6 +3515,25 @@ def summarize_bench(out: dict) -> dict:
             and (cols := _capacity_cols(row)) is not None}
         if capacity:
             s["serving_capacity"] = capacity
+        # fleet columns (ISSUE 19 sat.) — sparse like the others:
+        # [replicas, domains_killed, recovered_exactly_once] for rows
+        # that drove the discrete-event fleet harness
+
+        def _fleet_cols(row):
+            n = row.get("fleet_replicas")
+            if n is None:
+                return None
+            return [n, row.get("domains_killed"),
+                    row.get("recovered_exactly_once")]
+
+        fleet = {
+            name: cols
+            for name, row in list(fam.items()) + [("serving", sv)]
+            if isinstance(row, dict) and "skipped" not in row
+            and "error" not in row
+            and (cols := _fleet_cols(row)) is not None}
+        if fleet:
+            s["serving_fleet"] = fleet
     elif isinstance(m, dict):
         s["model"] = {"error": str(m["error"])[:120]}
 

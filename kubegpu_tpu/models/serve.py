@@ -4474,18 +4474,15 @@ class DataParallelServePool:
         self._engine_kw = engine_kw
         self._trace_ctx = trace_ctx
         self._blocks = list(range(dp))    # replica → tp-device block
-        # ONE shared tracer across replicas: a failed-over request's
-        # replay spans land on the same timeline as its first life
-        self.replicas = [
-            ContinuousBatcher(
-                params, cfg,
-                mesh=make_serve_mesh(tp, devs[i * tp:(i + 1) * tp]),
-                metrics=metrics, chaos=chaos.get(i),
-                tracer=tracer, trace_ctx=trace_ctx, **engine_kw)
-            for i in range(dp)
-        ]
         self._metrics = metrics
         self._tracer = tracer
+        # ONE shared tracer across replicas: a failed-over request's
+        # replay spans land on the same timeline as its first life.
+        # Engines come from _build_engine() — the single construction
+        # seam shared with add_replica(), and the override point the
+        # fleet harness uses to mount cost-model replicas under this
+        # pool's unmodified routing/failover/autoscale logic.
+        self.replicas = [self._build_engine(i) for i in range(dp)]
         self.max_replays = int(max_replays)
         # host-side durability: pool rid → (prompt, budget, accepted
         # prefix from prior incarnations, current placement)
@@ -4519,6 +4516,22 @@ class DataParallelServePool:
         self.drain_replays = 0
         self.replicas_active_min = dp
         self.replicas_active_max = dp
+
+    def _build_engine(self, i: int):
+        """Build replica ``i``'s engine on its tp-device block.  The
+        ONLY place an engine is constructed (``__init__`` and
+        :meth:`add_replica` both route through here), so a subclass
+        that overrides it — e.g. the fleet harness's simulated
+        cost-model replica — inherits every routing / admission /
+        failover / autoscale path above it unmodified."""
+        b = self._blocks[i]
+        tp = self.tp
+        return ContinuousBatcher(
+            self._params, self._cfg,
+            mesh=make_serve_mesh(tp, self._devs[b * tp:(b + 1) * tp]),
+            metrics=self._metrics, chaos=self._chaos.get(i),
+            tracer=self._tracer, trace_ctx=self._trace_ctx,
+            **self._engine_kw)
 
     def warmup(self) -> None:
         for eng in self.replicas:
@@ -4662,19 +4675,13 @@ class DataParallelServePool:
                 f"{len(used)} blocks in use")
         b = free[0]
         i = len(self.replicas)
-        eng = ContinuousBatcher(
-            self._params, self._cfg,
-            mesh=make_serve_mesh(
-                tp, self._devs[b * tp:(b + 1) * tp]),
-            metrics=self._metrics, chaos=self._chaos.get(i),
-            tracer=self._tracer, trace_ctx=self._trace_ctx,
-            **self._engine_kw)
-        self.replicas.append(eng)
         # one entry per replica ever built — replica indices are stable
         # identities (dead ones keep their slot), so growth is bounded
         # by scale-up actions, not traffic
         # ktp: allow(KTP005) lifetime: one slot per replica identity
         self._blocks.append(b)
+        eng = self._build_engine(i)
+        self.replicas.append(eng)
         self._digests.append(set())
         self.dp = len(self.replicas)
         if gang is not None:
@@ -4999,8 +5006,15 @@ class DataParallelServePool:
                                        n_alive)
         if self._metrics is not None:
             # per-replica queue depth (the router's own signal,
-            # exported): one gauge per LIVE replica index — dead
-            # replicas' gauges were deleted at failover/drain
+            # exported): one gauge per LIVE replica index.  Dead
+            # replicas' gauges are deleted at failover/drain AND
+            # re-deleted here at the harvest choke point — idempotent,
+            # and it holds the no-stale-gauge invariant for any death
+            # path that reaches dead_replicas without _failover's
+            # cleanup (e.g. an engine declared dead between steps)
+            for i in self.dead_replicas:
+                self._metrics.delete_gauge(
+                    "serve_replica_queue_depth" + f"_r{i}")
             for i, eng in enumerate(self.replicas):
                 if i in self.dead_replicas:
                     continue

@@ -215,6 +215,23 @@ name                            kind       meaning
                                            serve harness via
                                            ``note_kv_quality``;
                                            ISSUE 15)
+``serve_fleet_replicas``        gauge      live simulated replicas in
+                                           the discrete-event fleet
+                                           harness (ISSUE 19)
+``serve_domain_kills_total``    counter    whole failure domains
+                                           (slice/rack/zone) killed in
+                                           one tick by the domain
+                                           chaos injector (ISSUE 19)
+``serve_ctrl_recoveries_total``  counter   control-plane crashes
+                                           recovered from the append-
+                                           only journal with every
+                                           in-flight request re-driven
+                                           exactly-once (ISSUE 19)
+``serve_upgrade_waves_total``   counter    rolling-upgrade drain waves
+                                           completed (one failure
+                                           domain retired through
+                                           replay parking and
+                                           backfilled; ISSUE 19)
 ==============================  =========  ============================
 
 Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as

@@ -348,3 +348,40 @@ def test_serving_bench_smoke_parses_and_carries_keys():
         ups = [t for t, d, _ in au["events"] if d == "up"]
         downs = [t for t, d, _ in au["events"] if d == "down"]
         assert ups and downs and min(downs) > min(ups)
+
+    # fleet-scale robustness (ISSUE 19 tentpole): the acceptance gate
+    # — a seeded trace over >= 64 simulated replicas survives the
+    # scenario matrix {whole-domain kill of >= 25% of the fleet in
+    # one tick, rolling upgrade wave across all domains, control-
+    # plane kill + journal recovery mid-trace} with zero lost, zero
+    # duplicated, tier ordering never inverted, and every scenario
+    # leg's per-request outcomes identical to the uninterrupted twin,
+    # deterministic by seed.
+    fl = doc["cb_fleet_chaos"]
+    assert fl["protocol"] == "fleet_discrete_event"
+    assert fl["fleet_replicas"] >= 64
+    assert fl["domains_killed"] >= 1
+    assert fl["domain_kill"]["kill_fraction"] >= 0.25, \
+        "domain kill must take >= 25% of the fleet in one tick"
+    assert fl["domain_kill"]["failovers"] \
+        >= fl["domain_kill"]["killed_replicas"]
+    assert fl["upgrade"]["waves"] == fl["domains"], \
+        "the upgrade wave must roll EVERY failure domain"
+    assert fl["upgrade"]["upgraded_replicas"] >= fl["fleet_replicas"]
+    assert fl["upgrade"]["min_alive"] >= fl["upgrade"]["floor"], \
+        "surge budget failed to hold the capacity floor"
+    assert fl["crash_recovery"]["recoveries"] == 1
+    assert fl["crash_recovery"]["redriven"] >= 1, \
+        "the crash landed after drain: nothing was in flight"
+    assert fl["exactly_once"] is True, \
+        "a scenario leg lost or duplicated a request"
+    assert fl["tier_inversions"] == 0, \
+        "tier ordering inverted under chaos"
+    assert fl["outcomes_identical"] is True, \
+        "a recovered run's outcomes diverged from its twin"
+    assert fl["recovered_exactly_once"] is True
+    assert fl["deterministic"] is True, \
+        "same seed + same chaos schedule produced different outcomes"
+    for leg in ("twin", "domain_kill", "upgrade", "crash_recovery"):
+        assert fl[leg]["completed"] == fl["requests"], leg
+        assert fl[leg]["lost"] == 0 and fl[leg]["duplicated"] == 0

@@ -40,7 +40,8 @@ class TestModelBench:
                             "continuous_batching",
                             "continuous_batching_flagship",
                             "cb_prefix_cache", "cb_chunked_stall",
-                            "cb_equal_hbm", "cb_spec"}
+                            "cb_equal_hbm", "cb_spec",
+                            "cb_fleet_chaos"}
         curve = fam["spec_decode_pld_curve"]
         assert len(curve) >= 3
         for p in curve:
@@ -88,6 +89,10 @@ class TestModelBench:
         assert fam["cb_prefix_cache"]["prefill_reduction_x"] > 1.0
         assert fam["cb_chunked_stall"]["on"]["chunk_cost_ms"] > 0
         assert fam["cb_equal_hbm"]["paged_vs_dense_equal_hbm"] > 0
+        # fleet chaos row rides along host-side; deep bars live in
+        # test_bench_smoke — here only presence + the headline gates
+        assert fam["cb_fleet_chaos"]["exactly_once"] is True
+        assert fam["cb_fleet_chaos"]["outcomes_identical"] is True
         # engine-integrated speculation rides the SAME trained model;
         # its structural bars live in test_bench_smoke — here only the
         # row's presence + parity (greedy bit-exact vs spec-off)

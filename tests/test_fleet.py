@@ -1,0 +1,371 @@
+"""Fleet-scale robustness (ISSUE 19): the REAL serving control plane
+over simulated cost-model replicas — correlated failure-domain chaos,
+health-watch delivery weather, rolling upgrade waves, and
+control-plane crash recovery from an append-only journal.
+
+The contract under EVERY scenario: no admitted request is lost, none
+completes twice, tier ordering never inverts, and every scenario
+run's per-request outcomes are identical to an uninterrupted twin —
+all deterministic by seed, no real accelerator involved."""
+
+import numpy as np
+import pytest
+
+from kubegpu_tpu.fleet import (
+    ControlPlaneJournal,
+    FleetConfig,
+    FleetDisaggPool,
+    FleetPool,
+    FleetTopology,
+    ReplicaCosts,
+    SimReplicaEngine,
+    UpgradeWaveController,
+    compare_outcomes,
+    run_fleet,
+)
+from kubegpu_tpu.loadgen import LoadSpec, TierSpec, synth_trace
+from kubegpu_tpu.obs.chaos import (
+    DOMAIN_EVICT,
+    DOMAIN_KILL,
+    KILL,
+    WATCH_DELAY,
+    WATCH_DUP,
+    WATCH_PARTITION,
+    WATCH_REORDER,
+    ChaosEvent,
+    ChaosInjector,
+    DomainChaosEvent,
+    DomainChaosInjector,
+)
+from kubegpu_tpu.obs.metrics import MetricsRegistry
+
+TIERS = (TierSpec("gold", ttft_slo_ticks=40, token_slo_ticks=40.0,
+                  share=0.2),
+         TierSpec("silver", ttft_slo_ticks=80, token_slo_ticks=80.0,
+                  share=0.3),
+         TierSpec("bronze", ttft_slo_ticks=10**6,
+                  token_slo_ticks=1e6, share=0.5))
+
+
+def mk_trace(n=96, seed=1907):
+    return synth_trace(LoadSpec(
+        seed=seed, n_requests=n, mean_iat_ticks=0.25, tiers=TIERS,
+        diurnal=True, flash_at=(10.0,), flash_rate_x=4.0,
+        flash_len_ticks=8.0))
+
+
+def drain_engine(eng):
+    out = []
+    while eng.slot_req or eng.queue:
+        out.extend(eng.step())
+    return out
+
+
+# -- the simulated engine ----------------------------------------------
+
+class TestSimEngine:
+    def test_tokens_deterministic_pure_function_of_sequence(self):
+        a, b = SimReplicaEngine(FleetConfig()), SimReplicaEngine(
+            FleetConfig())
+        pa = a.submit([3, 5, 7], 6)
+        pb = b.submit([3, 5, 7], 6)
+        ra = {r.rid: r for r in drain_engine(a)}[pa]
+        rb = {r.rid: r for r in drain_engine(b)}[pb]
+        assert ra.tokens == rb.tokens
+        assert len(ra.tokens) == 6
+        assert all(1 <= t < FleetConfig().vocab for t in ra.tokens)
+
+    def test_replay_as_prompt_plus_accepted_is_bit_exact(self):
+        ref = SimReplicaEngine(FleetConfig())
+        full_rid = ref.submit([3, 5, 7], 8)
+        full = {r.rid: r for r in drain_engine(ref)}[full_rid]
+        # interrupt after 3 tokens, replay prompt ++ accepted — the
+        # crc32 running state resumes exactly (the property every
+        # failover / preemption / migration replay leans on)
+        head = full.tokens[:3]
+        eng = SimReplicaEngine(FleetConfig())
+        replay = np.concatenate(
+            [np.asarray([3, 5, 7], np.int32),
+             np.asarray(head, np.int32)])
+        rid = eng.submit(replay, 5)
+        tail = {r.rid: r for r in drain_engine(eng)}[rid]
+        assert head + tail.tokens == full.tokens
+
+    def test_strict_tier_admission_no_inversion(self):
+        cfg = FleetConfig(n_slots=1)
+        eng = SimReplicaEngine(cfg)
+        eng.submit([2, 2], 2, tier=2)
+        eng.submit([3, 3], 2, tier=0)
+        eng.submit([4, 4], 2, tier=1)
+        drain_engine(eng)
+        tiers_in_order = [t for _, t, _ in eng.admission_log]
+        assert tiers_in_order == sorted(tiers_in_order)
+        assert eng.tier_inversions == 0
+
+    def test_prefix_registry_shortens_prefill(self):
+        cfg = FleetConfig(page_size=4, prefill_tokens_per_tick=4)
+        eng = SimReplicaEngine(cfg)
+        prompt = list(range(1, 17))
+        r1 = eng.submit(prompt, 2)
+        first = {r.rid: r for r in drain_engine(eng)}[r1]
+        r2 = eng.submit(prompt, 2)
+        second = {r.rid: r for r in drain_engine(eng)}[r2]
+        assert second.tokens == first.tokens
+        cold_ttft = first.first_tick - first.submit_tick
+        warm_ttft = second.first_tick - second.submit_tick
+        assert warm_ttft < cold_ttft
+
+    def test_kill_stashes_finishers_as_orphans(self):
+        from kubegpu_tpu.obs.chaos import ReplicaDeadError
+        eng = SimReplicaEngine(
+            FleetConfig(),
+            chaos=ChaosInjector(events=[ChaosEvent(tick=1,
+                                                   kind=KILL)]))
+        eng.submit([5, 5], 2)     # finishes ON the dying tick
+        eng.step()                # admit + prefill + first token
+        with pytest.raises(ReplicaDeadError):
+            eng.step()            # second token, then the kill lands
+        assert eng.dead is not None
+        # the dying tick's finisher went to the orphan stash, so the
+        # pool's failover must never replay a completed request
+        done = [r for r in eng.take_orphans() if r.done]
+        assert len(done) == 1 and len(done[0].tokens) == 2
+
+    def test_bench_calibration_reads_rows_or_defaults(self):
+        c = ReplicaCosts.from_bench(root="/nonexistent")
+        assert c.block_ms == ReplicaCosts.block_ms
+        c2 = ReplicaCosts.from_bench()
+        assert c2.block_ms > 0 and c2.prefill_ms_per_token > 0
+
+
+# -- the real pool over sim engines ------------------------------------
+
+class TestFleetPool:
+    def test_failover_exactly_once_bit_exact(self):
+        cfg = FleetConfig()
+        ref = FleetPool(cfg, dp=2)
+        pool = FleetPool(
+            cfg, dp=2,
+            chaos={0: ChaosInjector(
+                events=[ChaosEvent(tick=3, kind=KILL)])})
+        prompts = [[i + 2, i + 3, i + 4] for i in range(6)]
+        want, got = {}, {}
+        for p in prompts:
+            want[tuple(p)] = ref.submit(p, 6)
+            got[tuple(p)] = pool.submit(p, 6)
+        ref_out = {r.rid: r for r in ref.drain()}
+        out = {r.rid: r for r in pool.drain()}
+        assert pool.failovers >= 1
+        assert len(out) == len(prompts)          # exactly once
+        for p in prompts:
+            assert (out[got[tuple(p)]].tokens
+                    == ref_out[want[tuple(p)]].tokens)
+
+    def test_dead_replica_gauge_deleted_after_harvest(self):
+        reg = MetricsRegistry()
+        pool = FleetPool(
+            FleetConfig(), dp=2, metrics=reg,
+            chaos={1: ChaosInjector(
+                events=[ChaosEvent(tick=1, kind=KILL)])})
+        for i in range(4):
+            pool.submit([i + 2, i + 3], 4)
+        pool.drain()
+        assert 1 in pool.dead_replicas
+        gauges = reg.snapshot()["gauges"]
+        assert "serve_replica_queue_depth_r1" not in gauges
+        assert "serve_replica_queue_depth_r0" in gauges
+
+    def test_disagg_migration_over_sim_engines(self):
+        pool = FleetDisaggPool(FleetConfig(), prefill=1, decode=1)
+        ref = FleetPool(FleetConfig(), dp=1)
+        rids, refs = [], []
+        for i in range(4):
+            p = [i + 2, i + 3, i + 4, i + 5]
+            rids.append(pool.submit(p, 8))
+            refs.append(ref.submit(p, 8))
+        out = {r.rid: r for r in pool.drain()}
+        ref_out = {r.rid: r for r in ref.drain()}
+        assert pool.migrations >= 1
+        assert len(out) == 4
+        for rid, rref in zip(rids, refs):
+            assert out[rid].tokens == ref_out[rref].tokens
+
+
+# -- correlated failure domains ----------------------------------------
+
+class TestDomainChaos:
+    def test_injector_validates_scope(self):
+        with pytest.raises(ValueError):
+            DomainChaosInjector(events=[DomainChaosEvent(
+                tick=1, kind=KILL)])          # engine-scope kind
+        with pytest.raises(ValueError):
+            DomainChaosInjector(events=[DomainChaosEvent(
+                tick=1, kind=DOMAIN_KILL)])   # domain without target
+
+    def test_from_seed_deterministic(self):
+        a = DomainChaosInjector.from_seed(7, 50, ("rack0", "rack1"),
+                                          n_events=4)
+        b = DomainChaosInjector.from_seed(7, 50, ("rack0", "rack1"),
+                                          n_events=4)
+        assert a.events == b.events
+
+    def test_quarter_fleet_dies_in_one_tick_outcomes_identical(self):
+        trace = mk_trace()
+        twin = run_fleet(trace, TIERS, replicas=64, domains=4)
+        chaos = DomainChaosInjector(events=[DomainChaosEvent(
+            tick=12, kind=DOMAIN_KILL, domain="rack1")])
+        rep = run_fleet(trace, TIERS, replicas=64, domains=4,
+                        chaos=chaos)
+        assert rep.killed_replicas == 16          # >= 25% in one tick
+        assert rep.failovers >= 16
+        assert rep.load.lost == 0 and rep.load.duplicated == 0
+        assert rep.tier_inversions == 0
+        assert compare_outcomes(twin.load, rep.load)["identical"]
+
+    def test_watch_weather_dup_delay_reorder_idempotent(self):
+        trace = mk_trace()
+        twin = run_fleet(trace, TIERS, replicas=16, domains=4)
+        chaos = DomainChaosInjector(events=[
+            DomainChaosEvent(tick=8, kind=WATCH_DUP, dup=3,
+                             duration_ticks=8),
+            DomainChaosEvent(tick=8, kind=WATCH_DELAY,
+                             delay_ticks=3, duration_ticks=8),
+            DomainChaosEvent(tick=8, kind=WATCH_REORDER,
+                             duration_ticks=8),
+            DomainChaosEvent(tick=10, kind=DOMAIN_KILL,
+                             domain="rack2"),
+        ])
+        rep = run_fleet(trace, TIERS, replicas=16, domains=4,
+                        chaos=chaos)
+        # 4 gangs x dup 3 — every duplicate/late delivery a no-op
+        assert rep.watch_delivered >= 12
+        assert rep.load.lost == 0 and rep.load.duplicated == 0
+        assert compare_outcomes(twin.load, rep.load)["identical"]
+
+    def test_watch_partition_stale_reads_then_heal(self):
+        trace = mk_trace()
+        twin = run_fleet(trace, TIERS, replicas=16, domains=4)
+        # evict-only domain loss: the ONLY signal travels the watch,
+        # and the watch is partitioned — routing keeps targeting the
+        # condemned replicas (stale reads) until heal
+        chaos = DomainChaosInjector(events=[
+            DomainChaosEvent(tick=9, kind=WATCH_PARTITION,
+                             duration_ticks=6),
+            DomainChaosEvent(tick=10, kind=DOMAIN_EVICT,
+                             domain="rack3"),
+        ])
+        rep = run_fleet(trace, TIERS, replicas=16, domains=4,
+                        chaos=chaos)
+        assert rep.domain_evictions == 1
+        assert rep.watch_delivered >= 4   # flushed after heal
+        assert rep.load.lost == 0 and rep.load.duplicated == 0
+        assert compare_outcomes(twin.load, rep.load)["identical"]
+
+    def test_deterministic_by_seed(self):
+        trace = mk_trace()
+
+        def once():
+            return run_fleet(
+                trace, TIERS, replicas=32, domains=4,
+                chaos=DomainChaosInjector(events=[DomainChaosEvent(
+                    tick=12, kind=DOMAIN_KILL, domain="rack0")]))
+
+        a, b = once(), once()
+        cmp_ = compare_outcomes(a.load, b.load)
+        assert cmp_["identical"] and cmp_["checked"] == len(trace)
+
+
+# -- rolling upgrades ---------------------------------------------------
+
+class TestRollingUpgrade:
+    def test_waves_cover_all_domains_floor_held(self):
+        trace = mk_trace()
+        twin = run_fleet(trace, TIERS, replicas=64, domains=4)
+        # floor HALF a domain above worst case: the first drain batch
+        # lands exactly on the floor, so completion proves the
+        # controller backfills mid-wave instead of wedging
+        rep = run_fleet(trace, TIERS, replicas=64, domains=4,
+                        upgrade=True, upgrade_floor=56,
+                        upgrade_surge=4, upgrade_start=8)
+        assert rep.upgrade_waves == 4             # every domain
+        assert rep.upgraded_replicas == 64        # whole fleet
+        assert rep.min_alive >= 56                # floor never broken
+        assert rep.load.lost == 0 and rep.load.duplicated == 0
+        assert rep.tier_inversions == 0
+        assert compare_outcomes(twin.load, rep.load)["identical"]
+
+    def test_surge_credit_returns_fleet_to_nominal(self):
+        pool = FleetPool(FleetConfig(), dp=8, max_replicas=24)
+        topo = FleetTopology.grid(8, 2)
+        for i in range(8):
+            pool.bind_replica_gang(i, f"g{i}")
+        upg = UpgradeWaveController(pool, topo, floor=6, surge=2)
+        tick = 0
+        while not upg.done and tick < 200:
+            upg.on_tick(tick)
+            pool.step()
+            tick += 1
+        assert upg.done and upg.waves_done == 2
+        assert len(pool._alive()) == 8            # nominal size
+        assert upg.min_alive >= 6
+
+
+# -- control-plane crash recovery ---------------------------------------
+
+class TestCrashRecovery:
+    def test_mid_trace_crash_recovers_exactly_once(self):
+        trace = mk_trace()
+        twin = run_fleet(trace, TIERS, replicas=32, domains=4)
+        journal = ControlPlaneJournal()
+        rep = run_fleet(trace, TIERS, replicas=32, domains=4,
+                        journal=journal, crash_at=12)
+        assert rep.recoveries == 1
+        assert rep.redriven >= 1                  # genuinely mid-trace
+        assert rep.load.lost == 0 and rep.load.duplicated == 0
+        assert rep.tier_inversions == 0
+        assert compare_outcomes(twin.load, rep.load)["identical"]
+        c = journal.counts()
+        assert c["crash"] == 1 and c["recovered"] == 1
+        assert c["finish"] >= c["submit"]         # every gid settled
+        assert c["resubmit"] == rep.redriven
+
+    def test_journal_inflight_is_submits_minus_finishes(self):
+        j = ControlPlaneJournal()
+        j.append("submit", gid=0, tier=0)
+        j.append("submit", gid=1, tier=1)
+        j.append("finish", gid=0)
+        assert j.inflight() == [1]
+
+    def test_recovery_redrives_in_tier_order(self):
+        trace = mk_trace()
+        journal = ControlPlaneJournal()
+        run_fleet(trace, TIERS, replicas=32, domains=4,
+                  journal=journal, crash_at=12)
+        redriven = [r["tier"] for r in journal.records
+                    if r["kind"] == "resubmit"]
+        assert redriven == sorted(redriven)
+
+
+# -- loadgen extensions -------------------------------------------------
+
+class TestLoadgenFleetKnobs:
+    def test_default_knobs_leave_traces_bit_identical(self):
+        base = dict(seed=11, n_requests=24, tiers=TIERS)
+        a = synth_trace(LoadSpec(**base))
+        b = synth_trace(LoadSpec(**base, diurnal=False, flash_at=()))
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert x["arrival_tick"] == y["arrival_tick"]
+            assert np.array_equal(x["prompt"], y["prompt"])
+
+    def test_diurnal_and_flash_modulate_arrivals(self):
+        base = dict(seed=11, n_requests=48, tiers=TIERS)
+        plain = synth_trace(LoadSpec(**base))
+        diurnal = synth_trace(LoadSpec(**base, diurnal=True))
+        flash = synth_trace(LoadSpec(**base, flash_at=(2.0,),
+                                     flash_rate_x=8.0,
+                                     flash_len_ticks=10.0))
+        t = [e["arrival_tick"] for e in plain]
+        assert [e["arrival_tick"] for e in diurnal] != t
+        tf = [e["arrival_tick"] for e in flash]
+        assert tf != t and tf[-1] < t[-1]         # compressed burst

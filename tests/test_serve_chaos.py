@@ -705,3 +705,114 @@ class TestOverloadAdmission:
         done2 = {r.rid: r for r in eng.drain()}
         assert done2[r4].error is None
         assert done2[r4].tokens == solo(params, p2, 5, cfg)
+
+
+class TestHealthWatchEdgeCases:
+    """Watch-delivery weather the fleet's DomainChaosInjector newly
+    exercises (ISSUE 19 sat.): duplicated eviction events, deliveries
+    arriving out of issue order, and an eviction for a gang that was
+    already drained by the autoscaler — every one an idempotent no-op
+    beyond its first effect."""
+
+    def _pool(self, params, cfg, dp=2, **kw):
+        kw.setdefault("n_slots", 2)
+        kw.setdefault("stride", 2)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("page_size", 8)
+        pool = DataParallelServePool(params, cfg, dp=dp, tp=1, **kw)
+        for i in range(dp):
+            pool.bind_replica_gang(i, f"serve{i}")
+        return pool
+
+    def test_duplicated_eviction_fails_over_once(self, tiny):
+        """The watch redelivers (at-least-once semantics): three
+        copies of the same eviction must cost exactly ONE failover."""
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        pool = self._pool(params, cfg)
+        prompts = mixed_prompts(cfg, n=5)
+        rids = {pool.submit(p, n): (p, n) for p, n in prompts}
+        for _ in range(3):
+            pool.observe_gang_eviction("serve0", "dup delivery")
+        assert len(pool._pending_deaths) == 1
+        done = {}
+        for r in pool.drain():
+            assert r.rid not in done, "duplicate completion"
+            done[r.rid] = r
+        # a straggler duplicate lands AFTER the failover completed
+        pool.observe_gang_eviction("serve0", "late duplicate")
+        assert not pool._pending_deaths
+        assert pool.failovers == 1
+        assert set(done) == set(rids)
+        for rid, (p, n) in rids.items():
+            assert done[rid].error is None
+            assert done[rid].tokens == solo(params, p, n, cfg)
+
+    def test_out_of_order_delivery_converges_to_same_state(self, tiny):
+        """Evictions issued (serve1 then serve2) but delivered in the
+        REVERSE order must reach the same end state: both replicas
+        dead, every request exactly once, tokens bit-exact."""
+        cfg, params = tiny
+        if len(jax.devices()) < 3:
+            pytest.skip("needs 3 devices")
+        pool = self._pool(params, cfg, dp=3)
+        prompts = mixed_prompts(cfg, n=6)
+        rids = {pool.submit(p, n): (p, n) for p, n in prompts}
+        pool.observe_gang_eviction("serve2", "issued second")
+        pool.observe_gang_eviction("serve1", "issued first")
+        done = {}
+        for r in pool.drain():
+            assert r.rid not in done
+            done[r.rid] = r
+        assert set(pool.dead_replicas) == {1, 2}
+        assert pool.failovers == 2
+        assert set(done) == set(rids)
+        for rid, (p, n) in rids.items():
+            assert done[rid].error is None
+            assert done[rid].tokens == solo(params, p, n, cfg)
+
+    def test_eviction_of_already_drained_gang_is_noop(self, tiny):
+        """The autoscaler's scale-down path: retire_replica drains
+        through replay parking, THEN the control plane's eviction for
+        that gang arrives on the watch — it must be a no-op, not a
+        second failover against a dead replica."""
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        pool = self._pool(params, cfg)
+        prompts = mixed_prompts(cfg, n=4)
+        rids = {pool.submit(p, n): (p, n) for p, n in prompts}
+        pool.retire_replica(0)
+        done = {r.rid: r for r in pool.drain()}
+        assert 0 in pool.dead_replicas and pool.drains == 1
+        pool.observe_gang_eviction("serve0", "watch caught up")
+        assert not pool._pending_deaths
+        pool.step()                       # must not fail anything over
+        assert pool.failovers == 0
+        assert set(done) == set(rids)
+        for rid, (p, n) in rids.items():
+            assert done[rid].error is None
+            assert done[rid].tokens == solo(params, p, n, cfg)
+
+    def test_chaos_failover_deletes_queue_depth_gauge(self, tiny):
+        """Regression (ISSUE 19 sat.): a chaos DEATH must delete the
+        per-replica queue-depth gauge just like an autoscale drain
+        does — a dead replica frozen at its last depth on /metrics is
+        the leak this guards against."""
+        cfg, params = tiny
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        reg = MetricsRegistry()
+        pool = DataParallelServePool(
+            params, cfg, dp=2, tp=1, n_slots=2, stride=2,
+            prompt_buckets=(8, 16), page_size=8, metrics=reg,
+            chaos={1: ChaosInjector(
+                [ChaosEvent(tick=1, kind="kill_replica")])})
+        for p, n in mixed_prompts(cfg, n=5):
+            pool.submit(p, n)
+        pool.drain()
+        assert 1 in pool.dead_replicas
+        gauges = reg.snapshot()["gauges"]
+        assert "serve_replica_queue_depth_r1" not in gauges
+        assert "serve_replica_queue_depth_r0" in gauges
