@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: all native asan test bench bench-smoke chaos-smoke trace-smoke \
         fused-smoke hbm-smoke kv-smoke disagg-smoke slo-smoke \
-        route-smoke fleet-smoke analyze clean
+        route-smoke fleet-smoke obs-smoke analyze clean
 
 all: native
 
@@ -183,6 +183,28 @@ fleet-smoke:                    # ISSUE 19 fleet-scale robustness: the
 		assert f['upgrade_waves'] >= 1, f; \
 		assert f['recovered_exactly_once'], f; \
 		assert f['deterministic'], f"
+
+obs-smoke:                      # ISSUE 20 flight recorder: the
+	# time-series store + burn-rate alert unit suites, then the
+	# closed-loop bench leg — a domain kill must page from metrics
+	# alone within 16 ticks while the fault-free twin fires zero
+	# alerts, chip-tick attribution conserves exactly, outcomes stay
+	# bit-identical with recording on or off, and the per-tick
+	# sampling overhead stays under 5%.
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tsdb.py \
+		tests/test_alerts.py -q
+	JAX_PLATFORMS=cpu $(PY) -c "import json; \
+		from kubegpu_tpu.benchmark import run_serving_bench_smoke; \
+		row = run_serving_bench_smoke(legs=['cb_obs_fleet']); \
+		print(json.dumps(row, indent=1)); \
+		o = row['cb_obs_fleet']; \
+		assert o['twin_alerts'] == 0, 'twin paged'; \
+		assert o['alert_within_bound'], o; \
+		assert o['deterministic'], 'alerting nondeterministic'; \
+		assert o['outcomes_identical_obs_off'], 'recorder steered'; \
+		assert o['chip_ticks_conserved'], 'chip-ticks leaked'; \
+		assert o['trace_validates'] and o['counter_events'] > 0, o; \
+		assert o['overhead_ok'], o['overhead_pct_raw']"
 
 trace-smoke:                    # ISSUE 6 observability: a traced serve
 	# window must yield ONE connected span tree from extender bind

@@ -78,6 +78,20 @@ METRIC_CALL_RE = re.compile(
 SPAN_CALL_RE = re.compile(
     r"\.(?:start_span|span|add_span|instant)\(\s*[\"']"
     r"([a-z0-9_]+\.[a-z0-9_.]+|request)[\"']", re.S)
+# ISSUE 20 satellite: the flight-recorder surfaces join the census —
+# a SeriesStore windowed query names a sampled metric series, and an
+# AlertRule names both itself and the series it watches; all three
+# literals must be documented in obs/metrics.py like any metric name
+SERIES_CALL_RE = re.compile(
+    r"\.(?:rate|avg|max|latest|values|series|ended)\(\s*[\"']"
+    r"([a-z0-9_]+)[\"']", re.S)
+ALERT_RULE_RE = re.compile(
+    r"AlertRule\(\s*(?:name\s*=\s*)?[\"']([a-z0-9_]+)[\"']", re.S)
+ALERT_SERIES_RE = re.compile(
+    r"series\s*=\s*[\"']([a-z0-9_]+)[\"']", re.S)
+# histogram percentile tracks sample as <hist>_p50 / <hist>_p99 — a
+# query on the track is documented via the underlying histogram row
+_SERIES_SUFFIX_RE = re.compile(r"_(?:p50|p99)$")
 
 
 def _dotted(node: ast.AST) -> str:
@@ -599,9 +613,14 @@ def lint_metric_names(root: pathlib.Path,
         lines = src.splitlines()
         for regex, kind, documented in (
                 (METRIC_CALL_RE, "metric", docs["metrics"]),
-                (SPAN_CALL_RE, "span", docs["spans"])):
+                (SPAN_CALL_RE, "span", docs["spans"]),
+                (SERIES_CALL_RE, "series", docs["metrics"]),
+                (ALERT_RULE_RE, "alert rule", docs["metrics"]),
+                (ALERT_SERIES_RE, "alert series", docs["metrics"])):
             for m in regex.finditer(src):
                 name = m.group(1)
+                if kind in ("series", "alert series"):
+                    name = _SERIES_SUFFIX_RE.sub("", name)
                 if name in documented:
                     continue
                 line = src.count("\n", 0, m.start()) + 1

@@ -1671,6 +1671,8 @@ def _families_bench(cfg, params, on_tpu) -> dict:
     # discrete-event simulation, so the same full-size run rides both
     # branches in well under a second
     out["cb_fleet_chaos"] = _cb_fleet_chaos_bench()
+    # flight-recorder loop (ISSUE 20) rides the same host-side harness
+    out["cb_obs_fleet"] = _cb_obs_fleet_bench()
 
     # --- train the bench model on a cyclic pattern --------------------
     # One training pays for TWO honest speculative rows: the PLD
@@ -2773,6 +2775,150 @@ def _cb_fleet_chaos_bench(replicas: int = 64, domains: int = 4,
     }
 
 
+def _cb_obs_fleet_bench(replicas: int = 32, domains: int = 4,
+                        requests: int = 192) -> dict:
+    """Flight-recorder closed loop (ISSUE 20 tentpole): the fleet
+    harness runs a seeded multi-tenant trace four times —
+
+    - **twin**: fault-free, FlightRecorder on → MUST fire zero alerts
+      (the burn windows never breach on healthy traffic);
+    - **kill**: ``rack1`` (25% of the fleet) dies at tick 20 with the
+      recorder watching — the failover burn-rate rule must page from
+      metrics alone within 16 ticks of the kill;
+    - **kill2**: identical re-run — alert log AND per-request outcomes
+      must be bit-identical (alerting is tick-deterministic);
+    - **off**: same kill with NO metrics/recorder — outcomes must be
+      identical to the recorded kill (observation never steers).
+
+    Every leg also proves exact integer chip-tick conservation
+    (Σ per-(tenant,tier) attribution == Σ replica busy chip-ticks) and
+    the twin reports the recorder's per-tick sampling overhead, gated
+    at ≤ 5% of leg wall (tick-denominated outcomes are the contract;
+    the wall numbers are weather)."""
+    import time
+
+    from kubegpu_tpu.fleet import (
+        FleetConfig,
+        ReplicaCosts,
+        compare_outcomes,
+        run_fleet,
+    )
+    from kubegpu_tpu.loadgen import LoadSpec, TierSpec, synth_trace
+    from kubegpu_tpu.obs.alerts import FlightRecorder
+    from kubegpu_tpu.obs.chaos import (
+        DOMAIN_KILL,
+        DomainChaosEvent,
+        DomainChaosInjector,
+    )
+    from kubegpu_tpu.obs.metrics import MetricsRegistry
+    from kubegpu_tpu.obs.spans import Tracer, validate_chrome_trace
+
+    KILL_TICK = 20
+    ALERT_BOUND_TICKS = 16
+    TIERS = (TierSpec("gold", ttft_slo_ticks=40,
+                      token_slo_ticks=40.0, share=0.2),
+             TierSpec("silver", ttft_slo_ticks=80,
+                      token_slo_ticks=80.0, share=0.3),
+             TierSpec("bronze", ttft_slo_ticks=10**6,
+                      token_slo_ticks=1e6, share=0.5))
+    trace = synth_trace(LoadSpec(
+        seed=1907, n_requests=requests, mean_iat_ticks=0.25,
+        tiers=TIERS, tenants=("acme", "blue", "coral"),
+        diurnal=True, flash_at=(10.0,), flash_rate_x=4.0,
+        flash_len_ticks=8.0))
+    cfg = FleetConfig(costs=ReplicaCosts.from_bench())
+
+    def _weather():
+        return DomainChaosInjector(events=[DomainChaosEvent(
+            tick=KILL_TICK, kind=DOMAIN_KILL, domain="rack1")])
+
+    def _leg(recorder=None, metrics=None, **kw):
+        t0 = time.perf_counter()
+        rep = run_fleet(trace, TIERS, cfg=cfg, replicas=replicas,
+                        domains=domains, controller=recorder,
+                        metrics=metrics, **kw)
+        return rep, time.perf_counter() - t0
+
+    # warmup twin: pays interpreter cold-start so the measured twin
+    # doesn't bill it to the sampling-overhead number; it is ALSO a
+    # second overhead sample — the reported steady-state figure is the
+    # min of the two (best-of-N, the standard defense against a CPU-
+    # contention spike landing on exactly one leg)
+    warm_reg = MetricsRegistry()
+    warm_rec = FlightRecorder(warm_reg)
+    _, warm_wall = _leg(warm_rec, warm_reg)
+
+    twin_reg = MetricsRegistry()
+    twin_rec = FlightRecorder(twin_reg)
+    twin, twin_wall = _leg(twin_rec, twin_reg)
+
+    tracer = Tracer()
+    kill_reg = MetricsRegistry()
+    kill_rec = FlightRecorder(kill_reg, tracer=tracer)
+    kill, _ = _leg(kill_rec, kill_reg, chaos=_weather())
+
+    kill2_reg = MetricsRegistry()
+    kill2_rec = FlightRecorder(kill2_reg)
+    kill2, _ = _leg(kill2_rec, kill2_reg, chaos=_weather())
+
+    off, _ = _leg(chaos=_weather())
+
+    conserved = all(
+        r.busy_chip_ticks == sum(r.cost_by_key.values()) == r.busy_ticks
+        for r in (twin, kill, kill2, off))
+    fired = kill_rec.alert_log()
+    first_alert_tick = fired[0][0] if fired else None
+    latency = (first_alert_tick - KILL_TICK
+               if first_alert_tick is not None else None)
+
+    # Perfetto proof: the kill leg's counter tracks merge into the
+    # (possibly empty) span trace and the result still validates
+    merged = kill_rec.store.merge_chrome_trace(tracer.to_chrome_trace())
+    events = validate_chrome_trace(merged)
+    counter_events = sum(1 for e in events if e["ph"] == "C")
+
+    pcts = [100.0 * rec.obs_wall_s / wall
+            for rec, wall in ((warm_rec, warm_wall),
+                              (twin_rec, twin_wall)) if wall > 0]
+    overhead_pct = min(pcts) if pcts else 0.0
+    overhead_tick_us = min(
+        warm_rec.overhead_per_tick_s, twin_rec.overhead_per_tick_s) * 1e6
+    return {
+        "protocol": "fleet_flight_recorder",
+        "fleet_replicas": replicas,
+        "domains": domains,
+        "domains_killed": kill.domain_kills,
+        "requests": len(trace),
+        "kill_tick": KILL_TICK,
+        "alert_bound_ticks": ALERT_BOUND_TICKS,
+        # headline gates (tier-1 asserts these)
+        "twin_alerts": len(twin_rec.alert_log()),
+        "alerts_fired": len(fired),
+        "first_alert_tick": first_alert_tick,
+        "alert_latency_ticks": latency,
+        "alert_within_bound": (latency is not None
+                               and latency <= ALERT_BOUND_TICKS),
+        "alert_log": [list(t) for t in fired],
+        "deterministic": (
+            kill_rec.alert_log() == kill2_rec.alert_log()
+            and compare_outcomes(kill.load, kill2.load)["identical"]),
+        "outcomes_identical_obs_off": compare_outcomes(
+            kill.load, off.load)["identical"],
+        "chip_ticks_conserved": conserved,
+        "busy_chip_ticks": kill.busy_chip_ticks,
+        "cost_summary": kill.cost_summary(),
+        "goodput_per_chip_tick":
+            kill.cost_summary()["goodput_per_chip_tick"],
+        "series_sampled": len(kill_rec.store.names()),
+        "counter_events": counter_events,
+        "trace_validates": True,
+        "overhead_per_tick_us_raw": round(overhead_tick_us, 2),
+        "overhead_pct_raw": round(overhead_pct, 3),
+        "overhead_pct_legs_raw": [round(p, 3) for p in pcts],
+        "overhead_ok": overhead_pct <= 5.0,
+    }
+
+
 def run_serving_bench_smoke(legs=None) -> dict:
     """Tiny-config run of ONLY the serving fast-path bench legs
     (prefix cache, chunked-prefill stall, equal-HBM mixed-length A/B,
@@ -2849,6 +2995,7 @@ def run_serving_bench_smoke(legs=None) -> dict:
             params, cfg),
         "cb_autoscale": lambda: _cb_autoscale_bench(params, cfg),
         "cb_fleet_chaos": _cb_fleet_chaos_bench,
+        "cb_obs_fleet": _cb_obs_fleet_bench,
         "cb_compile_census": _cb_compile_census_bench,
     }
     if legs is not None:
@@ -3534,6 +3681,25 @@ def summarize_bench(out: dict) -> dict:
             and (cols := _fleet_cols(row)) is not None}
         if fleet:
             s["serving_fleet"] = fleet
+        # chip-tick cost columns (ISSUE 20 tentpole) — sparse:
+        # [busy_chip_ticks, goodput_per_chip_tick, alert_latency_ticks]
+        # for rows that ran the flight-recorder loop
+
+        def _cost_cols(row):
+            n = row.get("busy_chip_ticks")
+            if n is None:
+                return None
+            return [n, row.get("goodput_per_chip_tick"),
+                    row.get("alert_latency_ticks")]
+
+        cost = {
+            name: cols
+            for name, row in list(fam.items()) + [("serving", sv)]
+            if isinstance(row, dict) and "skipped" not in row
+            and "error" not in row
+            and (cols := _cost_cols(row)) is not None}
+        if cost:
+            s["serving_cost"] = cost
     elif isinstance(m, dict):
         s["model"] = {"error": str(m["error"])[:120]}
 

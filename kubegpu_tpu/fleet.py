@@ -62,6 +62,7 @@ from kubegpu_tpu.obs.chaos import (DOMAIN_EVICT, DOMAIN_KILL,
                                    WATCH_PARTITION, WATCH_REORDER,
                                    ChaosEvent, ChaosInjector,
                                    ReplicaDeadError, TickStallError)
+from kubegpu_tpu.obs.cost import CostLedger
 
 __all__ = ["ReplicaCosts", "FleetConfig", "SimReplicaEngine",
            "FleetPool", "FleetDisaggPool", "FleetTopology",
@@ -199,6 +200,12 @@ class SimReplicaEngine:
         self.spec_drafts_accepted = 0
         self.hbm_peak_bytes = 0
         self.sim_ms = 0.0           # cost-model wall clock (weather)
+        # chip-tick attribution (ISSUE 20): one chip-tick per busy
+        # engine tick (tp=1 in the sim), charged pro-rata by work
+        # units to the resident (tenant, tier) keys; busy_ticks is
+        # the independent counter the conservation law checks against
+        self.cost = CostLedger()
+        self.busy_ticks = 0
         # audit trail for the tier-ordering gate: (tick, tier, seq)
         # per admission, plus a counter that trips if an admission
         # ever jumps a strictly-more-critical queued request
@@ -427,6 +434,15 @@ class SimReplicaEngine:
         # prefill progress + decode: one token per READY slot per tick
         if self.slot_req:
             self.sim_ms += self.cfg.costs.block_ms
+            # chip-tick attribution (ISSUE 20), charged BEFORE the
+            # decode loop consumes _prefill_left so a prefilling
+            # slot's weight is its prefill work this tick
+            self.busy_ticks += 1
+            self.cost.charge(
+                [(r.tenant, r.tier,
+                  self.cfg.prefill_tokens_per_tick
+                  if self._prefill_left.get(s, 0) > 0 else 1)
+                 for s, r in sorted(self.slot_req.items())], 1)
         for slot in sorted(self.slot_req):
             req = self.slot_req[slot]
             if self._prefill_left.get(slot, 0) > 0:
@@ -792,6 +808,18 @@ class FleetReport:
     journal_records: int = 0
     failovers: int = 0
     sim_ms: float = 0.0
+    # chip-tick cost attribution (ISSUE 20): the fleet-wide ledger
+    # (closed pools merged in), plus the independent busy-tick count
+    # the exact conservation law is checked against
+    busy_chip_ticks: int = 0
+    busy_ticks: int = 0
+    cost_by_key: dict = field(default_factory=dict)
+
+    def cost_summary(self) -> dict:
+        """Goodput-per-chip-tick per (tenant, tier) — delegates to
+        the scored :class:`LoadReport`, which carries the same ledger
+        fields."""
+        return self.load.cost_summary()
 
 
 def compare_outcomes(a: LoadReport, b: LoadReport) -> dict:
@@ -885,6 +913,8 @@ def run_fleet(trace: list[dict], tiers: tuple[TierSpec, ...], *,
     tier_inv_closed = 0             # from pools already torn down
     failovers_closed = 0
     sim_ms_closed = 0.0
+    cost_closed = CostLedger()      # chip-ticks of torn-down pools
+    busy_ticks_closed = 0
     n_ok = n_fail = n_met = 0
     crashed = False
     i = 0
@@ -901,6 +931,11 @@ def run_fleet(trace: list[dict], tiers: tuple[TierSpec, ...], *,
                                    for e in pool.replicas)
             failovers_closed += pool.failovers
             sim_ms_closed += sum(e.sim_ms for e in pool.replicas)
+            # the chips the dead control plane's pool burned were
+            # real spend: close its ledger into the run total so the
+            # conservation law survives the crash boundary
+            cost_closed.merge(pool.cost)
+            busy_ticks_closed += pool.busy_ticks
             # the control plane is DEAD: pool, router digests, entry
             # ledger, watch channel — all host state is gone
             pool = _mk_pool(alive_n)
@@ -1027,6 +1062,12 @@ def run_fleet(trace: list[dict], tiers: tuple[TierSpec, ...], *,
             f"{len(pool._entries)} entries in flight)")
     rep.load = score_run(meta, seen, done_map, tiers, ticks=tick,
                          wall_s=wall)
+    fleet_cost = cost_closed.merge(pool.cost)
+    rep.busy_chip_ticks = fleet_cost.busy_chip_ticks
+    rep.busy_ticks = busy_ticks_closed + pool.busy_ticks
+    rep.cost_by_key = fleet_cost.as_dict()
+    rep.load.busy_chip_ticks = fleet_cost.busy_chip_ticks
+    rep.load.cost_by_key = dict(rep.cost_by_key)
     rep.load.publish(metrics)
     rep.tier_inversions = tier_inv_closed + sum(
         e.tier_inversions for e in pool.replicas)

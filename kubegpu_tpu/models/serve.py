@@ -118,6 +118,7 @@ from kubegpu_tpu.obs.chaos import (
     ReplicaDeadError,
     TickStallError,
 )
+from kubegpu_tpu.obs.cost import CostLedger
 from kubegpu_tpu.ops.flash_attention import NEG_INF
 from kubegpu_tpu.parallel.sharding import donating_jit
 
@@ -2100,6 +2101,18 @@ class ContinuousBatcher:
         self._tick_log: list[dict] = []   # per tick: admission work
         self._tick_work: list = []
         self._metrics = metrics
+        # chip-tick cost attribution (ISSUE 20): each dispatched tick
+        # charges tp × fused-k chip-ticks to the resident slots'
+        # (tenant, tier) keys, pro-rata by work units — prefill slots
+        # weigh the prompt tokens they prefilled this tick
+        # (_tick_prefill_tokens, filled at wave/chunk time), decode
+        # slots one unit each.  busy_ticks counts the device ticks
+        # independently, so the exact conservation law
+        # (Σ attributed == tp × busy_ticks) is checkable from outside
+        # the ledger.
+        self.cost = CostLedger()
+        self.busy_ticks = 0
+        self._tick_prefill_tokens: dict[int, int] = {}
         # -- speculative accounting (per-slot adaptive γ + the bench's
         # acceptance numerators).  ``_gcap`` is the per-slot cap the
         # next verify tick applies; ``_accept_ema`` the rolling match
@@ -2929,6 +2942,7 @@ class ContinuousBatcher:
                 remaining = req.remaining_new
                 self._set_active(slot, remaining > 1)
                 self.slot_req[slot] = req
+                self._tick_prefill_tokens[slot] = req.admit_len
                 self._await_first.add(slot)
                 self.emitted_tokens += 1
                 self._note_resume(req, slot)
@@ -3014,6 +3028,9 @@ class ContinuousBatcher:
                     attrs={"rid": req.rid, "slot": slot, "start": start,
                            "chunk": c})
             self.prefill_tokens += min(t - start, c)
+            self._tick_prefill_tokens[slot] = (
+                self._tick_prefill_tokens.get(slot, 0)
+                + min(t - start, c))
             st["next"] = start + c
             if st["next"] >= t:
                 # final chunk (it held position t-1): go live
@@ -3384,6 +3401,24 @@ class ContinuousBatcher:
                     self._metrics.inc("serve_dispatch_failures")
         self._die("dispatch failed 3 times in a row")
 
+    def _charge_chip_ticks(self) -> None:
+        """Attribute the chip-ticks of the dispatch that just went out
+        — ``_inflight_k`` device ticks × ``tp`` chips — to the
+        resident slots' (tenant, tier) keys (ISSUE 20).  Pro-rata by
+        work units: a prefilling slot weighs the prompt tokens it
+        prefilled this tick, a decoding slot one unit.  Called right
+        after a successful dispatch, so ``_inflight_k`` is the block
+        the device is actually computing."""
+        if not self.slot_req:
+            return
+        k = max(1, int(self._inflight_k or 1))
+        self.busy_ticks += k
+        entries = [(req.tenant, req.tier,
+                    self._tick_prefill_tokens.get(slot, 0) or 1)
+                   for slot, req in sorted(self.slot_req.items())]
+        self.cost.charge(entries, max(1, int(self.tp or 1)) * k)
+        self._tick_prefill_tokens.clear()
+
     # -- device-resident slot-state mirrors (ISSUE 8 satellite) ---------
     # Page tables, length scalars, capacity, the active mask, and the
     # spec γ caps used to re-upload from numpy on EVERY dispatch; each
@@ -3615,6 +3650,7 @@ class ContinuousBatcher:
                                       prev_k) + self._failed)
                 self._failed.clear()
                 raise
+            self._charge_chip_ticks()
             t0 = time.perf_counter()
             fused = np.asarray(prev)       # overlapped host readout
             dt = (time.perf_counter() - t0) * 1e3
@@ -3667,6 +3703,7 @@ class ContinuousBatcher:
                 t_d0 = (time.perf_counter()
                         if self._tracer is not None else 0.0)
                 self._dispatch_with_retry()
+                self._charge_chip_ticks()
                 self.stall_ms.append(stall)
                 self._tick_log.append({"tick": self._tick - 1,
                                        "work": self._tick_work})
@@ -5023,6 +5060,10 @@ class DataParallelServePool:
                     float(len(eng.queue)))
             self._metrics.set_gauge("serve_replicas_active",
                                     float(n_alive))
+            self._metrics.set_gauge(
+                "serve_chip_ticks_total",
+                float(sum(e.cost.busy_chip_ticks
+                          for e in self.replicas)))
         return done
 
     def drain(self, max_ticks: int = 10_000) -> list[_Request]:
@@ -5134,6 +5175,20 @@ class DataParallelServePool:
     @property
     def hbm_peak_bytes(self) -> int:
         return sum(e.hbm_peak_bytes for e in self.replicas)
+
+    # chip-tick cost aggregates (ISSUE 20): dead replicas KEEP their
+    # ledgers — the chips they burned were real spend — so the
+    # pool-wide sum conserves across failover, drain, and scale-down
+    @property
+    def cost(self) -> CostLedger:
+        led = CostLedger()
+        for e in self.replicas:
+            led.merge(e.cost)
+        return led
+
+    @property
+    def busy_ticks(self) -> int:
+        return sum(e.busy_ticks for e in self.replicas)
 
 
 class DisaggServePool(DataParallelServePool):

@@ -6,6 +6,12 @@ histogram → p50, allocation locality gauge) and a structured per-decision
 schedule trace (why each slice scored what).
 """
 
+from kubegpu_tpu.obs.alerts import (
+    Alert,
+    AlertEngine,
+    AlertRule,
+    FlightRecorder,
+)
 from kubegpu_tpu.obs.chaos import (
     ChaosEvent,
     ChaosInjector,
@@ -13,6 +19,7 @@ from kubegpu_tpu.obs.chaos import (
     ReplicaDeadError,
     TickStallError,
 )
+from kubegpu_tpu.obs.cost import CostLedger
 from kubegpu_tpu.obs.logging import configure as configure_logging
 from kubegpu_tpu.obs.logging import get_logger
 from kubegpu_tpu.obs.metrics import MetricsRegistry, global_registry
@@ -24,10 +31,13 @@ from kubegpu_tpu.obs.spans import (
     Tracer,
 )
 from kubegpu_tpu.obs.trace import ScheduleTrace, TraceEvent
+from kubegpu_tpu.obs.tsdb import SeriesStore
 
 __all__ = ["MetricsRegistry", "global_registry", "ScheduleTrace",
            "TraceEvent", "get_logger", "configure_logging",
            "ChaosEvent", "ChaosInjector", "DispatchFailure",
            "ReplicaDeadError", "TickStallError",
            "Tracer", "Span", "SpanContext",
-           "TRACE_ANNOTATION", "TRACE_ENV"]
+           "TRACE_ANNOTATION", "TRACE_ENV",
+           "SeriesStore", "Alert", "AlertEngine", "AlertRule",
+           "FlightRecorder", "CostLedger"]

@@ -58,6 +58,10 @@ name                            kind       meaning
 ``serving_kv_quality_delta``    gauge      pod-harvested kv-compression
                                            quality-delta mirror
                                            (ISSUE 15)
+``serving_chip_ticks_total``    gauge      pod-harvested chip-tick
+                                           spend mirror, from
+                                           ``serve_chip_ticks_total``
+                                           (ISSUE 20)
 ==============================  =========  ============================
 
 Serving engine (observed by ``ContinuousBatcher`` /
@@ -232,7 +236,30 @@ name                            kind       meaning
                                            domain retired through
                                            replay parking and
                                            backfilled; ISSUE 19)
+``serve_chip_ticks_total``      gauge      chip-ticks charged to
+                                           resident work by the cost
+                                           ledger (one chip busy one
+                                           engine tick); suffixed
+                                           ``_<tenant>_t<k>`` per
+                                           (tenant, tier) key, exact
+                                           integer conservation vs
+                                           the engines' busy ticks
+                                           (ISSUE 20)
+``serve_alerts_fired``          counter    burn-rate alerts fired by
+                                           the flight recorder's
+                                           multi-window rules
+                                           (ISSUE 20)
 ==============================  =========  ============================
+
+Alert RULE names (ISSUE 20 — ``obs/alerts.py`` burn-rate rules over
+flight-recorder series; the KTP004 census checks ``AlertRule`` name
+and series literals against this registry): ``alert_failover_burn``
+(failure-domain loss via the ``serve_failover_total`` delta series),
+``alert_shed_burn`` (sustained admission-control shed pressure via
+``serve_requests_shed`` deltas), ``alert_slo_burn`` (SLO
+error-budget burn via the ``serve_slo_attainment`` series).
+Histogram series sampled through ``obs/tsdb.SeriesStore`` appear as
+``_p50``/``_p99``-suffixed tracks of their documented base name.
 
 Trace spans (ISSUE 6 — recorded by ``obs/spans.Tracer``, exported as
 Chrome/Perfetto JSON, not scraped): ``sched.schedule``, ``sched.bind``,
@@ -251,8 +278,10 @@ preemption, ISSUE 13),
 ``replicas_active``, ``drain_replays`` — one autoscale action,
 ISSUE 14), ``engine.tick``,
 ``engine.dispatch``, ``engine.verify``, ``engine.collect``,
-``engine.admit``, plus ``sched.<kind>`` instants forwarded from
-ScheduleTrace for linked gangs.  The serve pod echoes the span census
+``engine.admit``, ``alert.fired`` (attrs: ``rule``, ``series``,
+``tick``, ``fast``, ``slow`` — one burn-rate alert landing on the
+flame+counter timeline, ISSUE 20), plus ``sched.<kind>`` instants
+forwarded from ScheduleTrace for linked gangs.  The serve pod echoes the span census
 as the ``serve_trace_spans`` metric line.  The ``cb_trace_overhead``
 bench row asserts tracing on/off is bit-exact with bounded overhead.
 """
@@ -366,6 +395,7 @@ class MetricsRegistry:
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._hists: dict[str, _Histogram] = {}
+        self._gauge_del_hooks: list = []
 
     def inc(self, name: str, delta: float = 1.0) -> None:
         with self._lock:
@@ -375,13 +405,29 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = value
 
+    def add_gauge_delete_hook(self, fn) -> None:
+        """Register an observer called (outside the lock) with each
+        gauge name that :meth:`delete_gauge` actually removes — the
+        seam ``obs/tsdb.SeriesStore`` uses to END a per-instance
+        series at the same choke point that drops its gauge
+        (ISSUE 20)."""
+        with self._lock:
+            # ktp: allow(KTP005) one hook per attached SeriesStore
+            self._gauge_del_hooks.append(fn)
+
     def delete_gauge(self, name: str) -> None:
         """Drop a gauge from the scrape surface entirely (idempotent).
         Per-instance gauges (``serve_replica_queue_depth_r<i>``) use
         this when the instance goes away — a drained replica must
-        vanish from ``/metrics``, not freeze at its last depth."""
+        vanish from ``/metrics``, not freeze at its last depth.
+        Delete hooks fire only on an ACTUAL removal, so the pool's
+        idempotent re-deletes at the harvest choke point stay
+        no-ops."""
         with self._lock:
-            self._gauges.pop(name, None)
+            existed = self._gauges.pop(name, None) is not None
+            hooks = list(self._gauge_del_hooks) if existed else []
+        for fn in hooks:
+            fn(name)
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
@@ -422,10 +468,20 @@ class MetricsRegistry:
         registered as BOTH gauge and histogram
         (harvest_workload_metrics does this) exports the gauge as
         ``<name>_last`` — a duplicate metric family is a hard parse
-        error that would fail the whole scrape.  One locked pass."""
+        error that would fail the whole scrape.  Every family gets a
+        ``# HELP`` line sourced from the METRICS TABLE docstring
+        (ISSUE 20); undocumented names carry an explicit stub so the
+        gap is visible in the scrape itself.  One locked pass."""
+        docs = documented_names()["docs"]
+
         def sanitize(name: str) -> str:
             return "kubetpu_" + "".join(
                 c if c.isalnum() or c == "_" else "_" for c in name)
+
+        def help_line(m: str, name: str) -> str:
+            text = docs.get(name) or (
+                f"undocumented metric {name} (no METRICS TABLE row)")
+            return f"# HELP {m} " + text.replace("\\", "\\\\")
 
         def fmt_le(le: float) -> str:
             if le == float("inf"):
@@ -441,13 +497,15 @@ class MetricsRegistry:
         lines: list[str] = []
         for name, v in counters:
             m = sanitize(name)
-            lines += [f"# TYPE {m} counter", f"{m} {v}"]
+            lines += [help_line(m, name), f"# TYPE {m} counter",
+                      f"{m} {v}"]
         for name, v in gauges:
             m = sanitize(name + "_last" if name in hist_names else name)
-            lines += [f"# TYPE {m} gauge", f"{m} {v}"]
+            lines += [help_line(m, name), f"# TYPE {m} gauge",
+                      f"{m} {v}"]
         for name, buckets, n, total in hist_rows:
             m = sanitize(name)
-            lines.append(f"# TYPE {m} histogram")
+            lines += [help_line(m, name), f"# TYPE {m} histogram"]
             for le, c in buckets:
                 lines.append(f'{m}_bucket{{le="{fmt_le(le)}"}} {c}')
             lines.append(f"{m}_count {n}")
@@ -486,12 +544,20 @@ class LiveBytesTracker:
 
 def parse_prometheus(text: str) -> dict[str, dict]:
     """Minimal 0.0.4 parser for the trace-smoke gate: returns
-    family → {"type", "samples": {name+labels: value}} and raises
-    ValueError on malformed lines, duplicate families, or
-    non-monotonic histogram buckets."""
+    family → {"type", "help", "samples": {name+labels: value}} and
+    raises ValueError on malformed lines, duplicate families, or
+    non-monotonic histogram buckets.  ``# HELP`` text round-trips
+    (ISSUE 20): the help recorded before a family's TYPE line rides
+    on the family."""
     families: dict[str, dict] = {}
+    help_pending: dict[str, str] = {}
     for ln in text.splitlines():
         if not ln.strip():
+            continue
+        if ln.startswith("# HELP "):
+            rest = ln[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            help_pending[name] = help_text.replace("\\\\", "\\")
             continue
         if ln.startswith("# TYPE "):
             _, _, rest = ln.partition("# TYPE ")
@@ -500,7 +566,9 @@ def parse_prometheus(text: str) -> dict[str, dict]:
                 raise ValueError(f"duplicate family {name}")
             if kind not in ("counter", "gauge", "histogram", "summary"):
                 raise ValueError(f"bad type {kind!r} for {name}")
-            families[name] = {"type": kind, "samples": {}}
+            families[name] = {"type": kind,
+                              "help": help_pending.get(name),
+                              "samples": {}}
             continue
         if ln.startswith("#"):
             continue
@@ -559,15 +627,35 @@ def documented_names() -> dict[str, frozenset]:
     A *metric* row is any ````name```` literal of plain snake_case; a
     *span* name additionally contains a dot (``engine.tick``) or is
     the bare ``request`` root.  Returns
-    ``{"metrics": frozenset, "spans": frozenset}``; span names are
-    also valid ``add_span`` targets so both sets include the dotted
-    names."""
+    ``{"metrics": frozenset, "spans": frozenset, "docs": dict}``;
+    span names are also valid ``add_span`` targets so both sets
+    include the dotted names.  ``docs`` maps each TABLE-ROW name to
+    its one-line meaning (continuation lines folded in) — the source
+    of :meth:`MetricsRegistry.to_prometheus`'s ``# HELP`` text
+    (ISSUE 20)."""
     import re
     doc = __doc__ or ""
     names = set(re.findall(r"``([a-z0-9_][a-z0-9_.]*)``", doc))
     spans = frozenset(n for n in names if "." in n) | {"request"}
     metrics = frozenset(n for n in names if "." not in n)
-    return {"metrics": metrics, "spans": frozenset(spans)}
+    # help text: a table ROW opens with ``name`` at column 0 plus a
+    # kind and meaning; deeply-indented follow-up lines continue the
+    # meaning, and anything else (borders, prose, blanks) closes it
+    docs: dict[str, str] = {}
+    cur: str | None = None
+    for line in doc.splitlines():
+        m = re.match(r"``([a-z0-9_][a-z0-9_.]*)``\s+(\S+)\s+(\S.*)",
+                     line)
+        if m:
+            cur = m.group(1)
+            docs[cur] = m.group(3).strip()
+            continue
+        if cur is not None and re.match(r"\s{8,}\S", line):
+            docs[cur] = docs[cur] + " " + line.strip()
+            continue
+        cur = None
+    return {"metrics": metrics, "spans": frozenset(spans),
+            "docs": docs}
 
 
 global_registry = MetricsRegistry()

@@ -330,11 +330,15 @@ def validate_chrome_trace(text: str) -> list[dict]:
     if not isinstance(events, list):
         raise ValueError("traceEvents missing or not a list")
     for e in events:
-        if e.get("ph") not in ("X", "i", "B", "E", "M"):
+        if e.get("ph") not in ("X", "i", "B", "E", "M", "C"):
             raise ValueError(f"bad phase {e.get('ph')!r}")
         if not isinstance(e.get("ts"), (int, float)):
             raise ValueError(f"bad ts in {e.get('name')!r}")
         if e["ph"] == "X" and not isinstance(e.get("dur"),
                                              (int, float)):
             raise ValueError(f"X event without dur: {e.get('name')!r}")
+        if e["ph"] == "C" and not isinstance(
+                (e.get("args") or {}).get("value"), (int, float)):
+            raise ValueError(
+                f"C event without numeric value: {e.get('name')!r}")
     return events

@@ -232,7 +232,12 @@ class DeviceScheduler:
                 ("serve_pages_evicted_total",
                  "serving_pages_evicted_total"),
                 ("serve_kv_quality_delta",
-                 "serving_kv_quality_delta")):
+                 "serving_kv_quality_delta"),
+                # chip-tick spend (ISSUE 20): the pod's attributed
+                # cost currency, so placement can weigh goodput per
+                # chip-tick, not just goodput
+                ("serve_chip_ticks_total",
+                 "serving_chip_ticks_total")):
             v = out.get(src)
             if v is not None:
                 self.metrics.set_gauge(dst, v)

@@ -1,0 +1,198 @@
+"""ISSUE 20 flight recorder, paging half: multi-window burn-rate
+rules (fast AND slow window must breach), hold-tick hysteresis,
+cooldown, tick determinism, and the FlightRecorder controller that
+bolts the whole loop onto ``run_load``/``run_fleet``.
+"""
+
+import pytest
+
+from kubegpu_tpu.obs.alerts import (
+    BURN,
+    Alert,
+    AlertEngine,
+    AlertRule,
+    FlightRecorder,
+    default_rules,
+)
+from kubegpu_tpu.obs.metrics import MetricsRegistry
+from kubegpu_tpu.obs.spans import Tracer
+from kubegpu_tpu.obs.tsdb import SeriesStore
+
+
+def _drive(engine, reg, store, ticks, failovers=()):
+    fired = []
+    for t in range(ticks):
+        if t in failovers:
+            reg.inc("serve_failover_total", 16)
+        store.sample(t)
+        fired.extend(engine.evaluate(t))
+    return fired
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError):
+        AlertRule(name="x", series="s", kind="bogus")
+    with pytest.raises(ValueError):
+        AlertRule(name="x", series="s", fast_window=64, slow_window=8)
+    with pytest.raises(ValueError):
+        AlertRule(name="x", series="s", fast_window=0)
+
+
+def test_healthy_run_fires_nothing():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    engine = AlertEngine(store, metrics=reg)
+    assert _drive(engine, reg, store, 100) == []
+    assert list(engine.alerts) == []
+
+
+def test_failover_burst_pages_within_bound():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    engine = AlertEngine(store, metrics=reg)
+    fired = _drive(engine, reg, store, 60, failovers={20})
+    assert fired, "burst never paged"
+    a = fired[0]
+    assert a.rule == "alert_failover_burn"
+    assert a.tick - 20 <= 16
+    assert a.fast > a.slow > 0
+    assert reg.snapshot()["counters"]["serve_alerts_fired"] == len(fired)
+
+
+def test_both_windows_must_breach():
+    # a burst INSIDE the fast window but too small for the slow
+    # window's budget must not page: one failover in 64 ticks is
+    # 1/64 ≈ 0.016 < slow_threshold 0.02
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    engine = AlertEngine(store, metrics=reg)
+    assert _drive(engine, reg, store, 60, failovers=()) == []
+    reg2 = MetricsRegistry()
+    store2 = SeriesStore(reg2)
+    engine2 = AlertEngine(store2, metrics=reg2)
+    fired = []
+    for t in range(60):
+        if t == 20:
+            reg2.inc("serve_failover_total", 1)
+        store2.sample(t)
+        fired.extend(engine2.evaluate(t))
+    assert fired == []
+
+
+def test_hold_ticks_hysteresis():
+    # hold_ticks=3: the breach must PERSIST three consecutive
+    # evaluations before paging — a one-tick spike that decays out of
+    # the fast window before the streak completes never fires
+    rule = AlertRule(name="alert_failover_burn",
+                     series="serve_failover_total",
+                     fast_window=2, slow_window=4,
+                     fast_threshold=4.0, slow_threshold=0.5,
+                     hold_ticks=3)
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    engine = AlertEngine(store, rules=[rule], metrics=reg)
+    fired = []
+    for t in range(10):
+        if t == 2:
+            reg.inc("serve_failover_total", 10)
+        store.sample(t)
+        fired.extend(engine.evaluate(t))
+    # breach at t=2,3 only (fast window 2) — streak never reaches 3
+    assert fired == []
+
+
+def test_cooldown_suppresses_refires():
+    rule = AlertRule(name="alert_failover_burn",
+                     series="serve_failover_total",
+                     fast_window=2, slow_window=4,
+                     fast_threshold=0.5, slow_threshold=0.25,
+                     hold_ticks=1, cooldown_ticks=20)
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    engine = AlertEngine(store, rules=[rule], metrics=reg)
+    fired = []
+    for t in range(30):
+        reg.inc("serve_failover_total", 5)   # permanently on fire
+        store.sample(t)
+        fired.extend(engine.evaluate(t))
+    assert len(fired) == 2
+    assert fired[1].tick - fired[0].tick >= 20
+
+
+def test_burn_rule_measures_objective_shortfall():
+    rule = AlertRule(name="alert_slo_burn",
+                     series="serve_slo_attainment", kind=BURN,
+                     objective=0.95, fast_window=4, slow_window=8,
+                     fast_threshold=0.3, slow_threshold=0.2,
+                     hold_ticks=2)
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    engine = AlertEngine(store, rules=[rule], metrics=reg)
+    fired = []
+    for t in range(30):
+        # attainment collapses to 0.5 at tick 10
+        reg.set_gauge("serve_slo_attainment", 1.0 if t < 10 else 0.5)
+        store.sample(t)
+        fired.extend(engine.evaluate(t))
+    assert fired and fired[0].rule == "alert_slo_burn"
+    assert fired[0].tick >= 11   # hold_ticks=2 past the collapse
+    # an EMPTY window measures 0 burn: missing data is not an incident
+    empty = AlertEngine(SeriesStore(MetricsRegistry()), rules=[rule])
+    assert empty._measure(rule) == (0.0, 0.0)
+
+
+def test_alert_records_are_deterministic():
+    def once():
+        reg = MetricsRegistry()
+        store = SeriesStore(reg)
+        engine = AlertEngine(store, metrics=reg)
+        return _drive(engine, reg, store, 80, failovers={20, 60})
+    a, b = once(), once()
+    assert a == b
+    assert all(isinstance(x, Alert) for x in a)
+
+
+def test_default_rules_cover_documented_names():
+    from kubegpu_tpu.obs.metrics import documented_names
+    docs = documented_names()["metrics"]
+    for rule in default_rules():
+        assert rule.name in docs, rule.name
+        assert rule.series in docs, rule.series
+
+
+def test_alert_log_bounded():
+    rule = AlertRule(name="alert_failover_burn",
+                     series="serve_failover_total",
+                     fast_window=1, slow_window=1,
+                     fast_threshold=0.5, slow_threshold=0.5,
+                     hold_ticks=1, cooldown_ticks=0)
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    engine = AlertEngine(store, rules=[rule], metrics=reg,
+                         capacity=16)
+    for t in range(100):
+        reg.inc("serve_failover_total", 5)
+        store.sample(t)
+        engine.evaluate(t)
+    assert len(engine.alerts) == 16
+
+
+def test_flight_recorder_controller_contract():
+    reg = MetricsRegistry()
+    tracer = Tracer()
+    inner_calls = []
+    rec = FlightRecorder(reg, tracer=tracer,
+                         inner=lambda t, s: inner_calls.append(t))
+    for t in range(40):
+        if t == 20:
+            reg.inc("serve_failover_total", 16)
+        rec(t, {"attainment": 1.0})
+    assert inner_calls == list(range(40))       # chains the wrapped hook
+    assert rec.alert_log() == [(21, "alert_failover_burn")]
+    assert rec.ticks == 40
+    assert rec.overhead_per_tick_s > 0.0
+    # the attainment gauge was refreshed from the stats dict
+    assert reg.snapshot()["gauges"]["serve_slo_attainment"] == 1.0
+    # the firing landed on the span timeline as an alert.fired instant
+    trace = tracer.to_chrome_trace()
+    assert "alert.fired" in trace

@@ -130,6 +130,35 @@ def test_ktp004_undocumented_metric_name(tmp_path):
     assert "totally_novel_counter" in fs[0].message
 
 
+def test_ktp004_series_and_alert_names_join_the_census(tmp_path):
+    # ISSUE 20 satellite: SeriesStore windowed queries and AlertRule
+    # name/series literals are metric names too — an undocumented one
+    # fails the census exactly like a bogus .inc() name, while
+    # documented names (and their _p50/_p99 percentile tracks) pass
+    root = tmp_path / "fakepkg"
+    root.mkdir()
+    (root / "mod.py").write_text(textwrap.dedent("""\
+        from kubegpu_tpu.obs.alerts import AlertRule
+
+        def watch(store):
+            store.rate("serve_failover_total", 8)      # in the TABLE
+            store.avg("serve_ttft_ms_p99", 8)          # hist track: ok
+            store.max("bogus_series_name", 8)          # not in TABLE
+            return AlertRule(name="alert_failover_burn",
+                             series="serve_failover_total")
+
+        def bad_rule():
+            return AlertRule(name="alert_made_up",
+                             series="another_bogus_series")
+        """))
+    fs = [f for f in lint_metric_names(root, EMPTY) if not f.blessed]
+    msgs = [f.message for f in fs]
+    assert len(fs) == 3, msgs
+    assert any("bogus_series_name" in m for m in msgs)
+    assert any("alert_made_up" in m for m in msgs)
+    assert any("another_bogus_series" in m for m in msgs)
+
+
 def test_ktp005_unbounded_growth(tmp_path):
     fs = _lint(tmp_path, """\
         class RequestBatcher:

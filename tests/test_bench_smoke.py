@@ -385,3 +385,37 @@ def test_serving_bench_smoke_parses_and_carries_keys():
     for leg in ("twin", "domain_kill", "upgrade", "crash_recovery"):
         assert fl[leg]["completed"] == fl["requests"], leg
         assert fl[leg]["lost"] == 0 and fl[leg]["duplicated"] == 0
+
+    # fleet flight recorder (ISSUE 20 tentpole): the burn-rate engine
+    # must page from metrics alone within 16 ticks of a domain kill
+    # while the fault-free twin fires ZERO alerts; chip-tick cost
+    # attribution conserves exactly (Σ per-tenant == Σ busy);
+    # recording never steers the run (outcomes bit-identical on/off);
+    # the alert log itself is deterministic by seed; and the per-tick
+    # sampling overhead the twin measured stays under the 5% budget.
+    ob = doc["cb_obs_fleet"]
+    assert ob["protocol"] == "fleet_flight_recorder"
+    assert ob["twin_alerts"] == 0, \
+        "the fault-free twin paged — burn thresholds too hot"
+    assert ob["alerts_fired"] >= 1, "the domain kill never paged"
+    assert ob["alert_within_bound"] is True, \
+        (f"paged {ob['alert_latency_ticks']} ticks after the kill, "
+         f"bound is {ob['alert_bound_ticks']}")
+    assert ob["alert_log"][0][1] == "alert_failover_burn"
+    assert ob["deterministic"] is True, \
+        "same seed produced a different alert log or outcomes"
+    assert ob["outcomes_identical_obs_off"] is True, \
+        "the flight recorder steered the run"
+    assert ob["chip_ticks_conserved"] is True, \
+        "chip-tick attribution leaked or double-charged"
+    assert ob["busy_chip_ticks"] > 0
+    cs = ob["cost_summary"]
+    assert cs["attributed_chip_ticks"] == ob["busy_chip_ticks"]
+    assert sum(r["chip_ticks"] for r in cs["per_key"].values()) \
+        == ob["busy_chip_ticks"]
+    # three tenants x three tiers of traffic all got billed somewhere
+    assert len(cs["per_key"]) >= 3
+    assert ob["counter_events"] > 0 and ob["trace_validates"] is True
+    assert ob["series_sampled"] >= 10
+    assert ob["overhead_ok"] is True, \
+        f"sampling overhead {ob['overhead_pct_raw']}% > 5%"

@@ -41,7 +41,7 @@ class TestModelBench:
                             "continuous_batching_flagship",
                             "cb_prefix_cache", "cb_chunked_stall",
                             "cb_equal_hbm", "cb_spec",
-                            "cb_fleet_chaos"}
+                            "cb_fleet_chaos", "cb_obs_fleet"}
         curve = fam["spec_decode_pld_curve"]
         assert len(curve) >= 3
         for p in curve:

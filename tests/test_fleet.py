@@ -175,6 +175,33 @@ class TestFleetPool:
         assert "serve_replica_queue_depth_r1" not in gauges
         assert "serve_replica_queue_depth_r0" in gauges
 
+    def test_dead_replica_series_ends_at_harvest_choke_point(self):
+        # ISSUE 20 satellite: a SeriesStore attached to the REAL pool
+        # registry must close the dead replica's depth series at the
+        # same choke point that deletes its gauge — the survivor's
+        # series keeps taking points, the dead one stays frozen even
+        # though the harvest loop re-deletes the gauge every tick
+        from kubegpu_tpu.obs.tsdb import SeriesStore
+        reg = MetricsRegistry()
+        store = SeriesStore(reg)
+        pool = FleetPool(
+            FleetConfig(), dp=2, metrics=reg,
+            chaos={1: ChaosInjector(
+                events=[ChaosEvent(tick=1, kind=KILL)])})
+        for i in range(4):
+            pool.submit([i + 2, i + 3], 4)
+        tick = 0
+        while pool._entries or pool._pending_deaths:
+            pool.step()
+            store.sample(tick)
+            tick += 1
+        assert 1 in pool.dead_replicas
+        assert store.ended("serve_replica_queue_depth_r1")
+        assert not store.ended("serve_replica_queue_depth_r0")
+        dead = store.series("serve_replica_queue_depth_r1")
+        alive = store.series("serve_replica_queue_depth_r0")
+        assert alive and alive[-1][0] > (dead[-1][0] if dead else -1)
+
     def test_disagg_migration_over_sim_engines(self):
         pool = FleetDisaggPool(FleetConfig(), prefill=1, decode=1)
         ref = FleetPool(FleetConfig(), dp=1)
@@ -369,3 +396,72 @@ class TestLoadgenFleetKnobs:
         assert [e["arrival_tick"] for e in diurnal] != t
         tf = [e["arrival_tick"] for e in flash]
         assert tf != t and tf[-1] < t[-1]         # compressed burst
+
+
+# -- chip-tick cost attribution (ISSUE 20) ------------------------------
+
+class TestChipTickAttribution:
+    def test_conservation_exact_under_domain_kill(self):
+        trace = synth_trace(LoadSpec(
+            seed=1907, n_requests=96, mean_iat_ticks=0.25, tiers=TIERS,
+            tenants=("acme", "blue", "coral"), diurnal=True))
+        chaos = DomainChaosInjector(events=[DomainChaosEvent(
+            tick=10, kind=DOMAIN_KILL, domain="rack1")])
+        rep = run_fleet(trace, TIERS, replicas=16, domains=4,
+                        chaos=chaos)
+        assert rep.busy_chip_ticks > 0
+        # exact integer conservation: every busy replica-tick lands on
+        # exactly one (tenant, tier) key, dead replicas included
+        assert sum(rep.cost_by_key.values()) == rep.busy_chip_ticks
+        assert rep.busy_chip_ticks == rep.busy_ticks   # sim tp=1
+        assert all(isinstance(v, int) for v in rep.cost_by_key.values())
+        # every tenant that ran shows up billed
+        tenants = {k.split(":")[0] for k in rep.cost_by_key}
+        assert tenants == {"acme", "blue", "coral"}
+
+    def test_crash_closure_keeps_pre_crash_charges(self):
+        trace = mk_trace(n=64)
+        rep = run_fleet(trace, TIERS, replicas=16, domains=4,
+                        journal=ControlPlaneJournal(), crash_at=12)
+        assert rep.recoveries == 1
+        # the pre-crash pool's ledger was merged, not dropped: the
+        # total still balances against total busy ticks
+        assert sum(rep.cost_by_key.values()) == rep.busy_chip_ticks
+        assert rep.busy_chip_ticks == rep.busy_ticks
+
+    def test_cost_summary_joins_goodput(self):
+        trace = synth_trace(LoadSpec(
+            seed=7, n_requests=48, mean_iat_ticks=0.25, tiers=TIERS,
+            tenants=("acme", "blue")))
+        rep = run_fleet(trace, TIERS, replicas=8, domains=4)
+        cs = rep.cost_summary()
+        assert cs["busy_chip_ticks"] == rep.busy_chip_ticks
+        assert cs["attributed_chip_ticks"] == rep.busy_chip_ticks
+        for key, row in cs["per_key"].items():
+            assert row["chip_ticks"] >= 0
+            assert row["goodput_tokens"] <= row["total_tokens"]
+            if row["chip_ticks"]:
+                assert row["goodput_per_chip_tick"] == pytest.approx(
+                    row["goodput_tokens"] / row["chip_ticks"], rel=1e-3)
+        assert cs["goodput_per_chip_tick"] > 0
+
+    def test_suffixed_gauges_published(self):
+        reg = MetricsRegistry()
+        trace = synth_trace(LoadSpec(
+            seed=7, n_requests=32, mean_iat_ticks=0.25, tiers=TIERS,
+            tenants=("acme",)))
+        rep = run_fleet(trace, TIERS, replicas=8, domains=4,
+                        metrics=reg)
+        g = reg.snapshot()["gauges"]
+        assert g["serve_chip_ticks_total"] == float(rep.busy_chip_ticks)
+        per = {k: v for k, v in g.items()
+               if k.startswith("serve_chip_ticks_total_")}
+        assert per, "per-key suffixed gauges missing"
+        assert sum(per.values()) == float(rep.busy_chip_ticks)
+
+    def test_attribution_is_deterministic(self):
+        trace = mk_trace(n=48)
+        a = run_fleet(trace, TIERS, replicas=8, domains=4)
+        b = run_fleet(trace, TIERS, replicas=8, domains=4)
+        assert a.cost_by_key == b.cost_by_key
+        assert a.busy_chip_ticks == b.busy_chip_ticks

@@ -179,3 +179,86 @@ class TestRunLoad:
         with pytest.raises(RuntimeError, match="did not go idle"):
             run_load(_eng(params, cfg, metrics=MetricsRegistry()),
                      trace, TIERS, tiered=True, max_ticks=2)
+
+
+# -- chip-tick cost ledger + harvest (ISSUE 20) -------------------------
+
+class TestCostLedger:
+    def test_largest_remainder_conserves_exactly(self):
+        from kubegpu_tpu.obs.cost import CostLedger
+        import random
+        rng = random.Random(20)
+        led = CostLedger()
+        for _ in range(300):
+            n = rng.randrange(0, 5)
+            led.charge([("t%d" % rng.randrange(3), rng.randrange(3),
+                         rng.randrange(0, 7)) for _ in range(n)],
+                       rng.randrange(0, 30))
+        assert led.conserved
+        assert sum(led.by_key.values()) == led.busy_chip_ticks
+
+    def test_prorata_by_work_units(self):
+        from kubegpu_tpu.obs.cost import CostLedger
+        led = CostLedger()
+        led.charge([("a", 0, 3), ("b", 0, 1)], 4)
+        assert led.by_key == {"a:t0": 3, "b:t0": 1}
+
+    def test_zero_work_splits_equally(self):
+        from kubegpu_tpu.obs.cost import CostLedger
+        led = CostLedger()
+        led.charge([("a", 0, 0), ("b", 0, 0)], 5)
+        assert led.busy_chip_ticks == 5
+        assert sorted(led.by_key.values()) == [2, 3]
+
+    def test_remainder_tie_break_is_stable(self):
+        from kubegpu_tpu.obs.cost import CostLedger
+        a = CostLedger()
+        a.charge([("x", 0, 1), ("y", 0, 1), ("z", 0, 1)], 2)
+        b = CostLedger()
+        b.charge([("x", 0, 1), ("y", 0, 1), ("z", 0, 1)], 2)
+        assert a.by_key == b.by_key
+        assert sum(a.by_key.values()) == 2
+
+    def test_merge_accumulates(self):
+        from kubegpu_tpu.obs.cost import CostLedger
+        a, b = CostLedger(), CostLedger()
+        a.charge([("a", 0, 1)], 3)
+        b.charge([("a", 0, 1), ("b", 1, 1)], 4)
+        a.merge(b)
+        assert a.busy_chip_ticks == 7
+        assert a.conserved
+        assert a.by_key["a:t0"] == 5 and a.by_key["b:t1"] == 2
+
+    def test_publish_emits_total_and_suffixed_gauges(self):
+        from kubegpu_tpu.obs.cost import CostLedger
+        led = CostLedger()
+        led.charge([("acme", 1, 2), ("blue", 0, 2)], 10)
+        reg = MetricsRegistry()
+        led.publish(reg)
+        g = reg.snapshot()["gauges"]
+        assert g["serve_chip_ticks_total"] == 10.0
+        assert g["serve_chip_ticks_total_acme_t1"] == 5.0
+        assert g["serve_chip_ticks_total_blue_t0"] == 5.0
+
+
+class TestRunLoadCostHarvest:
+    def test_engine_ledger_lands_in_report(self, tiny):
+        cfg, params = tiny
+        reg = MetricsRegistry()
+        eng = _eng(params, cfg, metrics=reg)
+        trace = synth_trace(_spec(n_requests=8,
+                                  tenants=("acme", "blue")))
+        rep = run_load(eng, trace, TIERS, max_ticks=600, metrics=reg)
+        assert rep.completed == 8
+        # the engine charged tp(=1) chips per busy tick, exactly
+        assert rep.busy_chip_ticks == eng.busy_ticks
+        assert sum(rep.cost_by_key.values()) == rep.busy_chip_ticks
+        assert rep.busy_chip_ticks > 0
+        cs = rep.cost_summary()
+        assert cs["attributed_chip_ticks"] == rep.busy_chip_ticks
+        assert {k.split(":")[0] for k in cs["per_key"]} \
+            <= {"acme", "blue"}
+        # publish() mirrors the grand total onto the registry
+        g = reg.snapshot()["gauges"]
+        assert g["serve_chip_ticks_total"] == float(rep.busy_chip_ticks)
+        assert rep.as_dict()["busy_chip_ticks"] == rep.busy_chip_ticks

@@ -150,10 +150,22 @@ def test_tracer_chrome_export_trace_filter():
     {"traceEvents": [{"ph": "Z", "ts": 0}]},
     {"traceEvents": [{"ph": "X", "ts": "soon", "dur": 1}]},
     {"traceEvents": [{"ph": "X", "ts": 0.0}]},          # X without dur
+    # ph:"C" counter events (ISSUE 20) need a numeric args.value
+    {"traceEvents": [{"ph": "C", "ts": 0.0, "name": "g"}]},
+    {"traceEvents": [{"ph": "C", "ts": 0.0, "name": "g",
+                      "args": {"value": "high"}}]},
 ])
 def test_validate_chrome_trace_rejects_bad_shapes(doc):
     with pytest.raises(ValueError):
         validate_chrome_trace(json.dumps(doc))
+
+
+def test_validate_chrome_trace_accepts_counter_events():
+    doc = {"traceEvents": [
+        {"ph": "C", "ts": 1.0, "name": "serve_queue_depth", "pid": 1,
+         "tid": 0, "args": {"value": 3.0}}]}
+    events = validate_chrome_trace(json.dumps(doc))
+    assert events[0]["args"]["value"] == 3.0
 
 
 # ---------------------------------------------------------------------------
@@ -255,6 +267,30 @@ def test_registry_prometheus_roundtrip():
         'kubetpu_schedule_latency_ms_bucket{le="+Inf"}'] == 3.0
     assert hist["samples"][
         'kubetpu_schedule_latency_ms_bucket{le="1"}'] == 1.0
+
+
+def test_help_lines_ride_from_the_metrics_table():
+    # ISSUE 20 satellite: /metrics carries # HELP from the METRICS
+    # TABLE doc text; names without a table row get an explicit stub
+    reg = MetricsRegistry()
+    reg.inc("gangs_scheduled", 1)
+    reg.set_gauge("allocation_locality", 0.5)
+    reg.observe("schedule_latency_ms", 2.0)
+    reg.set_gauge("some_adhoc_gauge", 1.0)
+    text = reg.to_prometheus()
+    fams = parse_prometheus(text)
+    for fam in ("kubetpu_gangs_scheduled", "kubetpu_allocation_locality",
+                "kubetpu_schedule_latency_ms"):
+        h = fams[fam]["help"]
+        assert h and "undocumented" not in h, (fam, h)
+    stub = fams["kubetpu_some_adhoc_gauge"]["help"]
+    assert "undocumented metric some_adhoc_gauge" in stub
+    # every family in the exposition leads with its HELP line
+    lines = text.splitlines()
+    for i, ln in enumerate(lines):
+        if ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            assert lines[i - 1].startswith(f"# HELP {fam} "), fam
 
 
 def test_registry_gauge_histogram_collision_exports_last():

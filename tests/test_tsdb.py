@@ -1,0 +1,165 @@
+"""ISSUE 20 flight recorder, store half: SeriesStore ring semantics
+(gauges verbatim, counters as per-tick deltas, histogram percentile
+tracks), windowed queries, series END at the gauge-delete choke point,
+and the Perfetto counter-track merge.
+"""
+
+import json
+
+import pytest
+
+from kubegpu_tpu.obs.metrics import LiveBytesTracker, MetricsRegistry
+from kubegpu_tpu.obs.spans import Tracer, validate_chrome_trace
+from kubegpu_tpu.obs.tsdb import SeriesStore
+
+
+def test_capacity_validates():
+    with pytest.raises(ValueError):
+        SeriesStore(MetricsRegistry(), capacity=0)
+
+
+def test_gauges_sample_verbatim_counters_as_deltas():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    for t in range(5):
+        reg.set_gauge("allocation_locality", 0.1 * t)
+        reg.inc("gangs_scheduled", 2)
+        store.sample(t)
+    assert store.series("allocation_locality") == [
+        (t, pytest.approx(0.1 * t)) for t in range(5)]
+    # the counter went 2,4,6,8,10 — the series stores the deltas
+    assert store.series("gangs_scheduled") == [(t, 2.0) for t in range(5)]
+    assert store.latest("gangs_scheduled") == 2.0
+
+
+def test_histogram_percentile_tracks():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    for v in (1.0, 2.0, 100.0):
+        reg.observe("serve_ttft_ms", v)
+    store.sample(0)
+    assert "serve_ttft_ms_p50" in store.names()
+    assert "serve_ttft_ms_p99" in store.names()
+    assert store.latest("serve_ttft_ms_p99") >= store.latest(
+        "serve_ttft_ms_p50")
+
+
+def test_percentile_tracks_deterministic_at_scale():
+    # the seeded histogram reservoir replays identically, so the p50
+    # TRACK two identically-driven stores record is bit-identical even
+    # past the reservoir cap (determinism is what the alert gates on)
+    def drive():
+        reg = MetricsRegistry()
+        store = SeriesStore(reg)
+        for t in range(20):
+            for i in range(300):
+                reg.observe("serve_ttft_ms", float((t * 300 + i) % 997))
+            store.sample(t)
+        return store.series("serve_ttft_ms_p50"), store.series(
+            "serve_ttft_ms_p99")
+    assert drive() == drive()
+
+
+def test_ring_capacity_bounds_history():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg, capacity=8)
+    for t in range(100):
+        reg.set_gauge("allocation_locality", float(t))
+        store.sample(t)
+    hist = store.series("allocation_locality")
+    assert len(hist) == 8
+    assert hist[0] == (92, 92.0)
+    assert hist[-1] == (99, 99.0)
+
+
+def test_windowed_queries():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    for t in range(10):
+        reg.inc("gangs_scheduled", 4 if t >= 6 else 0)
+        reg.set_gauge("allocation_locality", float(t))
+        store.sample(t)
+    # (end-window, end] window: 4 deltas of 4 over the last 8 ticks
+    assert store.rate("gangs_scheduled", 8) == pytest.approx(16 / 8)
+    assert store.rate("gangs_scheduled", 4) == pytest.approx(16 / 4)
+    assert store.avg("allocation_locality", 4) == pytest.approx(7.5)
+    assert store.max("allocation_locality", 4) == 9.0
+    # explicit end_tick rewinds the window
+    assert store.rate("gangs_scheduled", 4, end_tick=5) == 0.0
+    assert store.max("allocation_locality", 3, end_tick=5) == 5.0
+    # unknown series measure empty, not KeyError
+    assert store.values("nope", 8) == []
+    assert store.rate("nope", 8) == 0.0
+    assert store.avg("nope", 8) == 0.0
+    assert store.max("nope", 8) == 0.0
+
+
+def test_series_ends_at_gauge_delete_choke_point():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    reg.set_gauge("serve_replica_queue_depth_r0", 3.0)
+    store.sample(0)
+    reg.delete_gauge("serve_replica_queue_depth_r0")
+    assert store.ended("serve_replica_queue_depth_r0")
+    # idempotent re-delete (the pool harvest loop re-deletes) is a
+    # no-op, and a LATER same-named gauge cannot resurrect the series
+    reg.delete_gauge("serve_replica_queue_depth_r0")
+    reg.set_gauge("serve_replica_queue_depth_r0", 99.0)
+    store.sample(1)
+    assert store.series("serve_replica_queue_depth_r0") == [(0, 3.0)]
+
+
+def test_delete_of_unknown_gauge_does_not_end_future_series():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    # deleting a name the store never sampled must not pre-poison it
+    reg.delete_gauge("serve_replica_queue_depth_r7")
+    reg.set_gauge("serve_replica_queue_depth_r7", 1.0)
+    store.sample(0)
+    assert store.series("serve_replica_queue_depth_r7") == [(0, 1.0)]
+
+
+def test_live_bytes_tracker_peak_series_matches_tracker():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    hbm = LiveBytesTracker(reg)
+    for t, b in enumerate((100, 900, 400, 700)):
+        hbm.sample(b)
+        store.sample(t)
+    peaks = [v for _, v in store.series("serve_hbm_peak_bytes")]
+    assert peaks == [100.0, 900.0, 900.0, 900.0]
+    assert store.latest("serve_hbm_peak_bytes") == hbm.peak
+    assert store.max("serve_hbm_pool_bytes", 4) == 900.0
+
+
+def test_counter_events_merge_into_chrome_trace():
+    reg = MetricsRegistry()
+    store = SeriesStore(reg)
+    for t in range(3):
+        reg.set_gauge("allocation_locality", float(t))
+        store.sample(t)
+    tracer = Tracer()
+    with tracer.span("engine.tick"):
+        pass
+    merged = store.merge_chrome_trace(tracer.to_chrome_trace())
+    events = validate_chrome_trace(merged)
+    cs = [e for e in events if e["ph"] == "C"]
+    assert len(cs) == 3
+    # counters anchor at the earliest span ts and stay sorted
+    span_ts = min(e["ts"] for e in events if e["ph"] != "C")
+    assert min(e["ts"] for e in cs) == span_ts
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+    assert all(isinstance(e["args"]["value"], float) for e in cs)
+
+
+def test_merge_rejects_bad_trace_doc():
+    store = SeriesStore(MetricsRegistry())
+    with pytest.raises(ValueError):
+        store.merge_chrome_trace(json.dumps({"traceEvents": "nope"}))
+
+
+def test_sample_without_registry_raises():
+    store = SeriesStore()
+    with pytest.raises(ValueError):
+        store.sample(0)
